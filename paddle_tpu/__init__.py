@@ -1,0 +1,200 @@
+"""paddle_tpu — a TPU-native deep learning framework with the
+capability surface of PaddlePaddle (reference snapshot: hnxxd/Paddle
+v2.3-dev), built from scratch on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `paddle.*` (reference:
+python/paddle/__init__.py): tensor ops, nn, optimizer, io, amp,
+distributed, vision, jit, static, metric, distribution.
+
+Architecture (vs the reference):
+- dygraph = tape autograd over pure-jax kernels (core/engine.py)
+- static graph / jit = jax.jit tracing of the same kernels (jit/)
+- kernels = functional jax ops (ops/) — the single PHI-like library
+- distributed = jax.sharding Mesh + XLA collectives (distributed/)
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_ as bool8, complex64, complex128,
+)
+from .core.place import (
+    CPUPlace, TPUPlace, CUDAPinnedPlace, Place, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core.tensor import Tensor, to_tensor, Parameter
+from .core.engine import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .core import engine as _engine
+from .core.flags import get_flags, set_flags
+
+from . import ops
+from .ops import *  # noqa: F401,F403 — flat paddle.* op surface
+from .ops.random import seed, get_rng_state, set_rng_state
+from .ops import random as _random_ops
+
+# subpackages (paddle.nn, paddle.optimizer, ...)
+from . import nn
+from . import optimizer
+from . import io
+from . import amp
+from . import jit
+from . import static
+from . import metric
+from . import distribution
+from . import vision
+from . import distributed
+from . import device
+from . import autograd
+from . import incubate
+from . import profiler
+from . import text
+from . import hub
+from . import onnx
+from . import sparse
+from . import linalg as _linalg_ns
+from . import fft
+from . import signal
+from . import version
+from .framework import save, load, set_default_dtype, get_default_dtype
+from .hapi import Model, summary, flops
+from .jit import to_static
+
+grad = _engine.grad
+
+__version__ = version.full_version
+
+
+def is_grad_enabled_():
+    return _engine.is_grad_enabled()
+
+
+def disable_static(place=None):
+    """Dygraph is the default mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    static._enable_static()
+
+
+def in_dynamic_mode():
+    return not static._static_mode()
+
+
+def get_device_name(device=None):
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def _register_tensor_methods():
+    """Attach the functional op surface as Tensor methods — the analog of
+    the generated `core.ops.*` method table (op_function_generator.cc:388).
+    """
+    import types
+
+    skip = {"to_tensor", "is_tensor", "seed", "zeros", "ones", "full",
+            "empty", "arange", "linspace", "logspace", "eye", "meshgrid",
+            "rand", "randn", "randint", "randperm", "uniform", "normal",
+            "standard_normal", "tril_indices", "triu_indices",
+            "broadcast_shape", "one_hot", "einsum"}
+    for name, fn in ops.PUBLIC_OPS.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # dunders
+    from .ops import math as m
+    from .ops import logic as lg
+    from .ops import linalg as la
+    from .ops import manipulation as mp
+
+    def _coerce(other, self):
+        return other
+
+    Tensor.__add__ = lambda s, o: m.add(s, o)
+    Tensor.__radd__ = lambda s, o: m.add(s, o)
+    Tensor.__sub__ = lambda s, o: m.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: m.subtract(o, s) if isinstance(o, Tensor) \
+        else m.scale(m.subtract(s, o), -1.0)
+    Tensor.__mul__ = lambda s, o: m.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: m.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: m.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: m.divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__floordiv__ = lambda s, o: m.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: m.mod(s, o)
+    Tensor.__pow__ = lambda s, o: m.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: m.pow(to_tensor(o), s)
+    Tensor.__neg__ = lambda s: m.neg(s)
+    Tensor.__abs__ = lambda s: m.abs(s)
+    Tensor.__matmul__ = lambda s, o: la.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: la.matmul(to_tensor(o), s)
+    Tensor.__eq__ = lambda s, o: lg.equal(s, o)
+    Tensor.__ne__ = lambda s, o: lg.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: lg.less_than(s, o)
+    Tensor.__le__ = lambda s, o: lg.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: lg.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: lg.greater_equal(s, o)
+    Tensor.__invert__ = lambda s: lg.logical_not(s)
+    Tensor.__and__ = lambda s, o: lg.logical_and(s, o) \
+        if s.dtype == bool8 else lg.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: lg.logical_or(s, o) \
+        if s.dtype == bool8 else lg.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: lg.logical_xor(s, o) \
+        if s.dtype == bool8 else lg.bitwise_xor(s, o)
+
+    # in-place-style helpers used by optimizers / init
+    def add_(self, y):
+        out = m.add(self, y)
+        self._value = out._value
+        return self
+
+    def subtract_(self, y):
+        out = m.subtract(self, y)
+        self._value = out._value
+        return self
+
+    def multiply_(self, y):
+        out = m.multiply(self, y)
+        self._value = out._value
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        with no_grad():
+            out = m.scale(self.detach(), scale, bias, bias_after_scale)
+        self._value = out._value
+        return self
+
+    def clip_(self, min=None, max=None):
+        with no_grad():
+            out = m.clip(self.detach(), min, max)
+        self._value = out._value
+        return self
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    Tensor.add_ = add_
+    Tensor.subtract_ = subtract_
+    Tensor.multiply_ = multiply_
+    Tensor.scale_ = scale_
+    Tensor.clip_ = clip_
+    Tensor.fill_ = fill_
+    Tensor.mean_all = lambda s: m.mean(s)
+
+
+_register_tensor_methods()
+
+# numpy-free default dtype helpers are in framework.py

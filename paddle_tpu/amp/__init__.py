@@ -1,0 +1,224 @@
+"""paddle.amp — automatic mixed precision (reference:
+python/paddle/amp/auto_cast.py, grad_scaler.py:26;
+C++ lists imperative/amp_auto_cast.h:44).
+
+TPU-native design: bf16-first. bfloat16 has fp32's exponent range, so
+loss scaling is a no-op by default (GradScaler still implements full
+dynamic scaling for fp16 parity). auto_cast O1 casts inputs of
+allow-list ops (matmul/conv) to bf16 at dispatch; O2 casts parameters.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..core.engine import no_grad
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard",
+           "white_list", "black_list"]
+
+# reference: imperative/amp_auto_cast.cc AmpOperators default lists
+WHITE_LIST = {"matmul", "mm", "bmm", "mv", "conv2d", "conv1d", "conv3d",
+              "linear", "einsum", "addmm",
+              "scaled_dot_product_attention"}
+BLACK_LIST = {"exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+              "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+              "layer_norm", "batch_norm", "norm", "cumsum", "pow",
+              "logsumexp"}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST},
+            "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": BLACK_LIST},
+            "bfloat16": {"O1": BLACK_LIST, "O2": BLACK_LIST}}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp = _AmpState()
+
+
+def amp_state():
+    return _amp
+
+
+def maybe_cast_inputs(op_name, vals):
+    """Called by the dispatcher: cast float32 arrays for allow-listed
+    ops to the amp dtype (O1 semantics)."""
+    if not _amp.enabled:
+        return vals
+    name = op_name
+    wl = (WHITE_LIST | _amp.custom_white) - _amp.custom_black
+    if name not in wl:
+        return vals
+
+    def cast(v):
+        if hasattr(v, "dtype") and v.dtype == jnp.float32:
+            return v.astype(_amp.dtype)
+        return v
+
+    import jax
+
+    return jax.tree_util.tree_map(cast, vals)
+
+
+from ..core import engine as _engine
+
+_engine.set_input_cast_hook(maybe_cast_inputs)
+
+
+class auto_cast:
+    """Context manager (paddle.amp.auto_cast)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self._enable = enable
+        self._white = set(custom_white_list or [])
+        self._black = set(custom_black_list or [])
+        self._level = level
+        self._dtype = convert_dtype(dtype)
+
+    def __enter__(self):
+        self._prev = (_amp.enabled, _amp.dtype, _amp.level,
+                      _amp.custom_white, _amp.custom_black)
+        _amp.enabled = self._enable
+        _amp.dtype = self._dtype
+        _amp.level = self._level
+        _amp.custom_white = self._white
+        _amp.custom_black = self._black
+        return self
+
+    def __exit__(self, *exc):
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+         _amp.custom_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model parameters to the amp dtype (master weights are
+    kept implicitly: optimizer states & updates run in fp32)."""
+    dt = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:26,
+    check_finite_and_unscale + update_loss_scaling ops)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..ops import math as m
+
+        return m.scale(loss, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._already_unscaled:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p._grad is None:
+                continue
+            g = p._grad._value.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found_inf = True
+            p._grad._value = g
+        self._found_inf = found_inf
+        self._already_unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)  # no-op if the user already unscaled
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        self._already_unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

@@ -1,0 +1,14 @@
+"""paddle.autograd (reference: python/paddle/autograd/)."""
+from ..core.engine import backward as _backward_engine
+from ..core.engine import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .py_layer import PyLayer, PyLayerContext
+from .functional import jacobian, hessian, vjp, jvp
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        _backward_engine(t, g, retain_graph=retain_graph)

@@ -1,0 +1,98 @@
+"""Functional autodiff API (reference: python/paddle/autograd/functional.py).
+
+TPU-native: these delegate to jax.jacobian/jvp/vjp over the pure traced
+function, rather than replaying the tape — exact and compiled.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import engine
+from ..core.tensor import Tensor
+
+
+def _fn_on_arrays(func, example_args):
+    def f(*arrs):
+        with engine.trace_mode():
+            targs = [Tensor(a, stop_gradient=False, _internal=True)
+                     for a in arrs]
+            out = func(*targs)
+            if isinstance(out, (list, tuple)):
+                return tuple(o._value for o in out)
+            return out._value
+
+    return f
+
+
+def _vals(xs):
+    if isinstance(xs, Tensor):
+        return (xs._value,), True
+    return tuple(x._value for x in xs), False
+
+
+def jacobian(func, xs, is_batched=False):
+    vals, single = _vals(xs)
+    f = _fn_on_arrays(func, vals)
+    jac = jax.jacobian(f, argnums=tuple(range(len(vals))))(*vals)
+    def wrap(j):
+        return Tensor(j, stop_gradient=True, _internal=True)
+
+    if single:
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return wrap(j)
+    return jax.tree_util.tree_map(wrap, jac)
+
+
+def hessian(func, xs, is_batched=False):
+    vals, single = _vals(xs)
+    f = _fn_on_arrays(func, vals)
+    hes = jax.hessian(f, argnums=tuple(range(len(vals))))(*vals)
+
+    def wrap(h):
+        return Tensor(h, stop_gradient=True, _internal=True)
+
+    if single:
+        h = hes
+        while isinstance(h, tuple):
+            h = h[0]
+        return wrap(h)
+    return jax.tree_util.tree_map(wrap, hes)
+
+
+def vjp(func, xs, v=None):
+    vals, single = _vals(xs)
+    f = _fn_on_arrays(func, vals)
+    out, vjp_fn = jax.vjp(f, *vals)
+
+    def wrap(o):
+        return Tensor(o, stop_gradient=True, _internal=True)
+
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vv = v if isinstance(v, (list, tuple)) else [v]
+        cot = tuple(t._value for t in vv)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    outs = jax.tree_util.tree_map(wrap, out)
+    gouts = [wrap(g) for g in grads]
+    return outs, (gouts[0] if single else gouts)
+
+
+def jvp(func, xs, v=None):
+    vals, single = _vals(xs)
+    f = _fn_on_arrays(func, vals)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        vv = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(t._value for t in vv)
+    out, tangent_out = jax.jvp(f, vals, tangents)
+
+    def wrap(o):
+        return Tensor(o, stop_gradient=True, _internal=True)
+
+    return (jax.tree_util.tree_map(wrap, out),
+            jax.tree_util.tree_map(wrap, tangent_out))

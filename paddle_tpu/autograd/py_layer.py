@@ -1,0 +1,93 @@
+"""Custom autograd via PyLayer (reference:
+python/paddle/autograd/py_layer.py, imperative/py_layer_fwd.h).
+
+TPU-native: a PyLayer's forward runs under no_grad; its backward is
+spliced into the tape as a synthetic node whose vjp calls the
+user-defined backward with Tensors.
+"""
+from __future__ import annotations
+
+from ..core import engine
+from ..core.engine import TapeNode, _state, no_grad
+from ..core.tensor import Tensor
+
+import jax.numpy as jnp
+from jax import tree_util
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        requires = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors)
+        if not requires:
+            return outs
+
+        out_avals = [(tuple(o.shape), o._value.dtype) for o in out_list]
+        out_treedef = tree_util.tree_structure([0] * len(out_list))
+
+        def vjp_fn(cotangents):
+            cots = [Tensor(c, stop_gradient=True, _internal=True)
+                    for c in cotangents]
+            with no_grad():
+                grads = cls.backward(ctx, *cots)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            vals = []
+            gi = 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = grads[gi] if gi < len(grads) else None
+                    gi += 1
+                    vals.append(None if g is None else
+                                (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(vals)
+
+        _state.seq += 1
+        node = TapeNode(_state.seq, f"py_layer_{cls.__name__}", vjp_fn,
+                        in_tensors, out_treedef, out_avals)
+        wrapped = []
+        for i, o in enumerate(out_list):
+            t = Tensor(o._value, stop_gradient=False, _internal=True)
+            t._node = node
+            t._out_index = i
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+PyLayerBackward = PyLayer

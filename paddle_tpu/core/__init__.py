@@ -1,0 +1,6 @@
+"""Core runtime: Tensor, autograd engine, places, dtypes, flags."""
+from . import dtype
+from . import flags
+from . import place
+from . import engine
+from . import tensor

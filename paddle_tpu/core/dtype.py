@@ -1,0 +1,88 @@
+"""Dtype system for paddle_tpu.
+
+Parity target: paddle's VarType dtype surface (reference:
+python/paddle/fluid/framework.py convert_np_dtype_to_dtype_,
+paddle/phi/common/data_type.h). TPU-native design: dtypes are thin
+aliases over jax/numpy dtypes; bfloat16 is first-class (MXU-native),
+float64 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects are numpy dtypes (bfloat16 comes from ml_dtypes
+# via jnp). Public names mirror paddle.{float32,...}.
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bfloat16": bfloat16,
+    "float16": float16,
+    "half": float16,
+    "float32": float32,
+    "float": float32,
+    "float64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = {jnp.dtype(d) for d in (bfloat16, float16, float32, float64)}
+INTEGER = {jnp.dtype(d) for d in (int8, int16, int32, int64, uint8)}
+COMPLEX = {jnp.dtype(d) for d in (complex64, complex128)}
+
+
+def convert_dtype(dtype):
+    """Normalize str/np/jnp dtype spec to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_TO_DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return jnp.dtype(_STR_TO_DTYPE[key])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return jnp.dtype(dtype) in INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return jnp.dtype(dtype) in COMPLEX
+
+
+def default_float_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flag("default_dtype"))
+
+
+def promote(*dtypes):
+    return np.result_type(*[jnp.dtype(d) for d in dtypes])

@@ -1,0 +1,480 @@
+"""Imperative (dygraph) engine: op dispatch + tape autograd.
+
+Parity target: the reference's imperative runtime —
+`Tracer::TraceOp` (paddle/fluid/imperative/tracer.cc:168),
+`BasicEngine::Execute` (basic_engine.cc:390), `GradientAccumulator`
+(gradient_accumulator.cc) and the eager `RunBackward`
+(paddle/fluid/eager/backward.cc:74).
+
+TPU-native design: every op is a *pure jax function*; the dygraph
+"kernel launch" is `jax.vjp` capture, which (a) executes the forward on
+the device via XLA/PJRT and (b) stores the residuals + a VJP closure as
+the grad node — i.e. the GradOpMaker and the kernel are the same
+artifact, derived by the autodiff system rather than hand-registered.
+`loss.backward()` walks the tape in reverse creation order (the
+reference's BFS with dep counting degenerates to this because the tape
+is append-only and ids are monotonic).
+
+Under `to_static`/jit tracing the tape is bypassed entirely and autograd
+is delegated to `jax.grad` over the whole step — the static-graph
+(Program → HLO) analog.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from . import flags
+
+__all__ = [
+    "Tensor_is_leaf",
+    "apply_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "in_trace_mode",
+    "trace_mode",
+    "backward",
+    "grad",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_mode = 0  # >0 when tracing for jit/to_static
+        self.seq = 0
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and _state.trace_mode == 0
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator disabling grad tracking (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        wrapper.__doc__ = getattr(fn, "__doc__", None)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class trace_mode:
+    """Active while tracing a function for jit; disables the tape."""
+
+    def __enter__(self):
+        _state.trace_mode += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_mode -= 1
+        return False
+
+
+def in_trace_mode() -> bool:
+    return _state.trace_mode > 0
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: the grad node (GradNodeBase analog)."""
+
+    __slots__ = (
+        "seq",
+        "name",
+        "vjp_fn",
+        "in_tensors",
+        "out_treedef",
+        "out_avals",
+        "n_out",
+        "out_refs",
+        "__weakref__",
+    )
+
+    def __init__(self, seq, name, vjp_fn, in_tensors, out_treedef, out_avals):
+        self.seq = seq
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.in_tensors = in_tensors  # flat list aligned w/ vjp cotangents
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals  # [(shape, dtype)] flat
+        self.n_out = len(out_avals)
+        self.out_refs = [None] * self.n_out  # weakrefs to output tensors
+
+    def __repr__(self):
+        return f"<TapeNode {self.name} #{self.seq}>"
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _unwrap(x):
+    from .tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+# Per-op executable cache (bounded LRU — distinct static-kwarg values
+# each compile their own executable; long eval loops with per-step
+# scalar attrs must not grow memory without bound).
+_jit_cache = __import__("collections").OrderedDict()
+_JIT_CACHE_MAX = 512
+
+# AMP O1 input-cast hook, registered by paddle_tpu.amp at import
+# (the analog of AmpOperators lists consulted in Tracer::TraceOp,
+# imperative/tracer.cc:205-219).
+_input_cast_hook = None
+
+
+def set_input_cast_hook(fn):
+    global _input_cast_hook
+    _input_cast_hook = fn
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(e) for e in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _jitted(fn, kwargs):
+    # Only cache module-level kernels: closures capture state that isn't
+    # part of the cache key, and their identity churns per call (which
+    # would grow the cache without bound). Those run via jax eager mode.
+    if getattr(fn, "__closure__", None) is not None:
+        return partial(fn, **kwargs)
+    try:
+        key = (fn, _freeze(kwargs))
+        hash(key)
+    except TypeError:
+        return partial(fn, **kwargs)
+    cached = _jit_cache.get(key)
+    if cached is None:
+        cached = jax.jit(partial(fn, **kwargs))
+        _jit_cache[key] = cached
+        if len(_jit_cache) > _JIT_CACHE_MAX:
+            _jit_cache.popitem(last=False)
+    else:
+        _jit_cache.move_to_end(key)
+    return cached
+
+
+def apply_op(name, fn, *args, **kwargs):
+    """Trace one op (Tracer::TraceOp analog).
+
+    Convention: all positional args are Tensors / arrays / (nested)
+    sequences of them; all static attributes are keyword args. `fn` is a
+    pure jax function returning an array or a pytree of arrays.
+    """
+    from .tensor import Tensor
+
+    flat_in, in_treedef = tree_util.tree_flatten(
+        args, is_leaf=lambda x: x is None or _is_tensor(x)
+    )
+    vals_flat = [_unwrap(x) for x in flat_in]
+    uargs = tree_util.tree_unflatten(in_treedef, vals_flat)
+
+    if _input_cast_hook is not None:
+        uargs = _input_cast_hook(name, uargs)
+
+    if in_trace_mode():
+        out_vals = fn(*uargs, **kwargs)
+        requires = _state.grad_enabled and any(
+            _is_tensor(t) and not t.stop_gradient for t in flat_in
+        )
+        return _wrap_outputs(out_vals, requires, node=None)
+
+    requires = is_grad_enabled() and any(
+        _is_tensor(t) and not t.stop_gradient for t in flat_in
+    )
+
+    if not requires:
+        if flags.get_flag("eager_op_jit"):
+            out_vals = _jitted(fn, kwargs)(*uargs)
+        else:
+            out_vals = fn(*uargs, **kwargs)
+        return _wrap_outputs(out_vals, False, node=None)
+
+    out_vals, vjp_fn = jax.vjp(lambda *a: fn(*a, **kwargs), *uargs)
+
+    out_flat, out_treedef = tree_util.tree_flatten(out_vals)
+    out_avals = [(tuple(o.shape), o.dtype) for o in out_flat]
+    _state.seq += 1
+    node = TapeNode(
+        _state.seq,
+        name,
+        vjp_fn,
+        [t if _is_tensor(t) else None for t in flat_in],
+        out_treedef,
+        out_avals,
+    )
+    return _wrap_outputs(out_vals, True, node=node)
+
+
+def _wrap_outputs(out_vals, requires, node):
+    from .tensor import Tensor
+
+    flat, treedef = tree_util.tree_flatten(out_vals)
+    out_tensors = []
+    for i, v in enumerate(flat):
+        t = Tensor(v, stop_gradient=not requires, _internal=True)
+        if node is not None:
+            t._node = node
+            t._out_index = i
+            node.out_refs[i] = weakref.ref(t)
+        out_tensors.append(t)
+    if flags.get_flag("check_nan_inf") and not in_trace_mode():
+        for t in out_tensors:
+            _check_nan_inf(t, node.name if node else "op")
+    return tree_util.tree_unflatten(treedef, out_tensors)
+
+
+def _check_nan_inf(t, opname):
+    v = t._value
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        bad = bool(jnp.any(~jnp.isfinite(v)))
+        if bad:
+            raise FloatingPointError(
+                f"Operator {opname} output contains NaN/Inf "
+                f"(FLAGS_check_nan_inf is set). shape={v.shape} dtype={v.dtype}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Backward engine
+# ---------------------------------------------------------------------------
+
+
+def _float_zero(aval):
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def _is_float_dtype(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating) or jnp.issubdtype(
+        jnp.dtype(dtype), jnp.complexfloating
+    )
+
+
+def _run_engine(seed_cotangents, *, collect=None, retain_graph=False,
+                accumulate_leaf=True):
+    """Reverse-walk the tape from the given roots.
+
+    seed_cotangents: {node: {out_index: cotangent}}
+    collect: optional dict id(tensor) -> slot; grads for these tensors
+      are gathered (paddle.grad / PartialGradEngine analog).
+    """
+    from .tensor import Tensor
+
+    node_cots = {}  # node -> {out_index: cot}
+    for node, cots in seed_cotangents.items():
+        node_cots.setdefault(node, {})
+        for i, c in cots.items():
+            prev = node_cots[node].get(i)
+            node_cots[node][i] = c if prev is None else prev + c
+
+    import heapq
+
+    heap = []
+    seen = set()
+    for node in node_cots:
+        heapq.heappush(heap, (-node.seq, id(node), node))
+        seen.add(id(node))
+
+    collected = {} if collect is not None else None
+    leaf_pending = {}  # id(t) -> [tensor, accumulated grad this pass]
+
+    def _apply_hooks(t, g):
+        for hook in list(t._hooks.values()):
+            out = hook(Tensor(g, stop_gradient=True, _internal=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+        return g
+
+    while heap:
+        _, _, node = heapq.heappop(heap)
+        cots = node_cots.pop(node, None)
+        if cots is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(set retain_graph=True on the first backward)."
+            )
+        # cotangents for this node's outputs are now final: fire hooks
+        # ONCE on the accumulated gradient (not per consumer edge)
+        for i in list(cots.keys()):
+            ref = node.out_refs[i]
+            t = ref() if ref is not None else None
+            if t is not None and t._hooks and cots[i] is not None:
+                cots[i] = _apply_hooks(t, cots[i])
+        out_flat = [
+            cots.get(i) if cots.get(i) is not None else _float_zero(node.out_avals[i])
+            for i in range(node.n_out)
+        ]
+        out_cot = tree_util.tree_unflatten(node.out_treedef, out_flat)
+        in_cots = node.vjp_fn(out_cot)
+        if not retain_graph:
+            node.vjp_fn = None
+        in_flat = tree_util.tree_leaves(
+            in_cots, is_leaf=lambda x: x is None
+        )
+        # align with node.in_tensors (same treedef as the op's args)
+        for t, g in zip(node.in_tensors, in_flat):
+            if t is None or g is None:
+                continue
+            if t.stop_gradient:
+                continue
+            if not _is_float_dtype(t.dtype):
+                continue
+            if g.dtype == jax.dtypes.float0:
+                continue
+            if g.dtype != t._value.dtype:
+                g = g.astype(t._value.dtype)
+            if collect is not None and id(t) in collect:
+                prev = collected.get(id(t))
+                collected[id(t)] = g if prev is None else prev + g
+            prod = t._node
+            if prod is not None:
+                d = node_cots.get(prod)
+                if d is None:
+                    node_cots[prod] = d = {}
+                prev = d.get(t._out_index)
+                d[t._out_index] = g if prev is None else prev + g
+                if id(prod) not in seen:
+                    seen.add(id(prod))
+                    heapq.heappush(heap, (-prod.seq, id(prod), prod))
+            elif accumulate_leaf and (collect is None or id(t) not in collect):
+                slot = leaf_pending.get(id(t))
+                if slot is None:
+                    leaf_pending[id(t)] = [t, g]
+                else:
+                    slot[1] = slot[1] + g
+    # finalize leaves: hooks see the full gradient of this pass, once
+    for t, g in leaf_pending.values():
+        if t._hooks:
+            g = _apply_hooks(t, g)
+        t._accumulate_grad(g)
+    return collected
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward() entry (BasicEngine::Execute analog)."""
+    from .tensor import Tensor
+
+    if tensor._node is None:
+        if tensor.stop_gradient:
+            raise RuntimeError(
+                "backward() on a tensor with stop_gradient=True and no grad graph"
+            )
+        return
+    if grad_tensor is None:
+        cot = jnp.ones(tensor.shape, tensor._value.dtype)
+    else:
+        cot = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    _run_engine(
+        {tensor._node: {tensor._out_index: cot}},
+        retain_graph=retain_graph,
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — PartialGradEngine analog (no .grad side effects)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    seeds = {}
+    for o, go in zip(outputs, grad_outputs):
+        if o._node is None:
+            continue
+        cot = (
+            go._value
+            if isinstance(go, Tensor)
+            else jnp.ones(o.shape, o._value.dtype)
+            if go is None
+            else jnp.asarray(go)
+        )
+        d = seeds.setdefault(o._node, {})
+        prev = d.get(o._out_index)
+        d[o._out_index] = cot if prev is None else prev + cot
+
+    collect = {id(t): None for t in inputs}
+    collected = _run_engine(
+        seeds, collect=collect, retain_graph=retain_graph,
+        accumulate_leaf=False,
+    )
+    results = []
+    for idx, t in enumerate(inputs):
+        g = collected.get(id(t)) if collected else None
+        if g is None:
+            if not allow_unused:
+                raise ValueError(
+                    f"The {idx}-th input tensor ({t.name}) is not used in "
+                    "computing the outputs — pass allow_unused=True to get "
+                    "None for unused inputs (paddle.grad contract).")
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=not create_graph, _internal=True))
+    return results
+
+
+def Tensor_is_leaf(t) -> bool:
+    return t._node is None

@@ -1,0 +1,76 @@
+"""Global flag registry.
+
+Parity target: gflags surface `FLAGS_*` + paddle.get_flags/set_flags
+(reference: paddle/fluid/platform/flags.cc,
+paddle/fluid/pybind/global_value_getter_setter.cc). TPU-native: flags are
+plain Python values read at dispatch time; env vars `FLAGS_*` seed them.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.RLock()
+
+
+def _env(name, default, cast):
+    raw = os.environ.get("FLAGS_" + name)
+    if raw is None:
+        return default
+    try:
+        if cast is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return cast(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+_FLAGS = {
+    # numerics
+    "check_nan_inf": _env("check_nan_inf", False, bool),
+    "default_dtype": _env("default_dtype", "float32", str),
+    # eager dispatch
+    "eager_op_jit": _env("eager_op_jit", True, bool),  # per-op jit cache
+    "benchmark": _env("benchmark", False, bool),  # block_until_ready each op
+    # memory
+    "fraction_of_gpu_memory_to_use": _env(
+        "fraction_of_gpu_memory_to_use", 0.92, float
+    ),
+    "allocator_strategy": _env("allocator_strategy", "auto_growth", str),
+    # comm
+    "max_inflight_collectives": _env("max_inflight_collectives", 8, int),
+    # logging
+    "v": _env("v", 0, int),  # VLOG level
+    "print_ir": _env("print_ir", False, bool),
+}
+
+
+def get_flag(name):
+    with _lock:
+        if name not in _FLAGS:
+            raise KeyError(f"Unknown flag: {name}")
+        return _FLAGS[name]
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    with _lock:
+        return {n: _FLAGS[n] for n in names}
+
+
+def set_flags(flags: dict):
+    with _lock:
+        for k, v in flags.items():
+            key = k[6:] if k.startswith("FLAGS_") else k
+            _FLAGS[key] = v
+
+
+def register_flag(name, default):
+    with _lock:
+        _FLAGS.setdefault(name, default)
+
+
+def VLOG(level: int, msg: str):
+    if _FLAGS["v"] >= level:
+        print(f"[VLOG{level}] {msg}")

@@ -1,0 +1,406 @@
+"""paddle_tpu.Tensor — the dygraph tensor.
+
+Parity target: `paddle.Tensor` (reference: VarBase,
+paddle/fluid/imperative/layer.h; eager Tensor,
+paddle/fluid/eager/autograd_meta.h; phi::DenseTensor,
+paddle/phi/core/dense_tensor.h:38).
+
+TPU-native design: storage is a `jax.Array` living on the device chosen
+by the current Place (PJRT buffer). Autograd metadata (`_node`,
+`_out_index`, `grad`) hangs directly off the tensor like the eager-mode
+AutogradMeta. Most arithmetic methods are attached at package import
+time from the functional op library (the reference's analog: methods
+generated onto VarBase by op_function_generator.cc:388).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from . import engine
+from .place import CPUPlace, Place, TPUPlace, current_device, get_device_place
+
+__all__ = ["Tensor", "to_tensor"]
+
+
+_tensor_name_counter = [0]
+
+
+def _next_name(prefix="generated_tensor"):
+    _tensor_name_counter[0] += 1
+    return f"{prefix}_{_tensor_name_counter[0]}"
+
+
+class Tensor:
+    # keep instances lightweight; autograd meta is per-instance
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_node",
+        "_out_index",
+        "_hooks",
+        "_hook_counter",
+        "name",
+        "persistable",
+        "is_parameter",
+        "trainable",
+        "_place",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True,
+                 _internal=False, name=None):
+        if _internal:
+            self._value = value
+        else:
+            dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+            if isinstance(value, Tensor):
+                value = value._value
+            if isinstance(value, jax.Array):
+                self._value = value.astype(dt) if dt is not None and value.dtype != dt else value
+            else:
+                arr = np.asarray(value)
+                if dt is None and arr.dtype == np.float64:
+                    dt = dtype_mod.default_float_dtype()
+                self._value = jnp.asarray(arr, dtype=dt)
+                if not engine.in_trace_mode():
+                    self._value = jax.device_put(
+                        self._value, _resolve_device(place)
+                    )
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._hooks = {}
+        self._hook_counter = 0
+        self.name = name or _next_name()
+        self.persistable = False
+        self.is_parameter = False
+        self.trainable = not stop_gradient
+        self._place = None
+
+    # -- basic meta -------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def dim(self):
+        return self._value.ndim
+
+    def rank(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(self._value.size)
+
+    @property
+    def place(self):
+        if self._place is not None:
+            return self._place
+        try:
+            dev = list(self._value.devices())[0]
+            plat = dev.platform
+        except Exception:
+            plat = "cpu"
+        return CPUPlace(0) if plat == "cpu" else TPUPlace(getattr(dev, "id", 0))
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.manipulation.t(self)
+
+    @property
+    def mT(self):
+        from .. import ops
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.manipulation.transpose(self, perm)
+
+    def numel(self):
+        return int(self._value.size)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- materialization --------------------------------------------------
+    def numpy(self):
+        if engine.in_trace_mode():
+            raise RuntimeError(
+                "Tensor.numpy() is not allowed inside to_static/jit tracing "
+                "(the value is an abstract tracer). Hoist it out of the "
+                "compiled region."
+            )
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        arr = self.numpy()
+        if args:
+            return arr.item(*args)
+        return arr.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if engine.in_trace_mode():
+            raise RuntimeError(
+                "bool(Tensor) inside jit tracing — use paddle_tpu ops "
+                "(where/cond) instead of Python control flow."
+            )
+        return bool(self.numpy())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __index__(self):
+        return int(self.item())
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        engine.backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def _accumulate_grad(self, g):
+        if self._grad is None:
+            self._grad = Tensor(g, stop_gradient=True, _internal=True)
+        else:
+            self._grad = Tensor(self._grad._value + g, stop_gradient=True,
+                                _internal=True)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value),
+                                stop_gradient=True, _internal=True)
+        else:
+            self._grad = None
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, _internal=True)
+        t.name = self.name + ".detach"
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops.creation import clone as _clone
+
+        return _clone(self)
+
+    def register_hook(self, hook):
+        self._hook_counter += 1
+        hid = self._hook_counter
+        self._hooks[hid] = hook
+
+        class _Handle:
+            def __init__(self, owner, hid):
+                self._owner, self._hid = owner, hid
+
+            def remove(self):
+                self._owner._hooks.pop(self._hid, None)
+
+        return _Handle(self, hid)
+
+    # -- conversion / placement ------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.manipulation.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        t = Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                   stop_gradient=self.stop_gradient, _internal=True)
+        return t
+
+    def to(self, *args, **kwargs):
+        # to(device), to(dtype), to(device, dtype)
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, Place)) and dtype is None and not _looks_like_dtype(a):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .place import set_device, get_device_place, device_of
+
+            place = device if isinstance(device, Place) else _parse_place(device)
+            out = Tensor(jax.device_put(out._value, device_of(place)),
+                         stop_gradient=out.stop_gradient, _internal=True)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _copy_to(self, place, blocking=True):
+        from .place import device_of
+
+        return Tensor(jax.device_put(self._value, device_of(place)),
+                      stop_gradient=self.stop_gradient, _internal=True)
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        new = jnp.asarray(value, dtype=self._value.dtype).reshape(self._value.shape)
+        try:
+            dev = list(self._value.devices())[0]
+            new = jax.device_put(new, dev)
+        except Exception:
+            pass
+        self._value = new
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- indexing ---------------------------------------------------------
+    def __getitem__(self, idx):
+        from .. import ops
+
+        return ops.manipulation.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .. import ops
+
+        if isinstance(value, Tensor):
+            value = value._value
+        idx = ops.manipulation._convert_index(idx)
+        self._value = self._value.at[idx].set(jnp.asarray(value, dtype=self.dtype))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- repr -------------------------------------------------------------
+    def __repr__(self):
+        if engine.in_trace_mode():
+            return (f"Tensor(traced, shape={self.shape}, dtype={self.dtype.name}, "
+                    f"stop_gradient={self.stop_gradient})")
+        grad_blurb = "" if self.stop_gradient else f", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+            f"place={self.place}{grad_blurb},\n       {np.asarray(self._value)!r})"
+        )
+
+    __str__ = __repr__
+
+    # NumPy interop
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # dunder arithmetic is attached in paddle_tpu/__init__.py from ops
+    __hash__ = object.__hash__
+
+
+def _looks_like_dtype(x):
+    if isinstance(x, str):
+        try:
+            dtype_mod.convert_dtype(x)
+            return True
+        except TypeError:
+            return False
+    return not isinstance(x, Place)
+
+
+def _parse_place(device):
+    from .place import set_device
+
+    name, _, idx = str(device).partition(":")
+    idx = int(idx) if idx else 0
+    if name.lower() == "cpu":
+        return CPUPlace(idx)
+    return TPUPlace(idx)
+
+
+def _resolve_device(place):
+    from .place import device_of
+
+    if place is None:
+        place = get_device_place()
+    elif not isinstance(place, Place):
+        place = _parse_place(place)
+    return device_of(place)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value, stop_gradient=stop_gradient, _internal=True)
+        if dtype is not None:
+            t = t.astype(dtype)
+            t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return t
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ParamBase analog, fluid/framework.py)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip")
+
+    def __init__(self, value, trainable=True, name=None, **kwargs):
+        super().__init__(value, stop_gradient=not trainable, name=name,
+                         _internal=isinstance(value, jax.Array))
+        self.is_parameter = True
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True

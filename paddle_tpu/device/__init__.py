@@ -1,0 +1,68 @@
+"""paddle.device surface (reference: python/paddle/device/__init__.py,
+set_device:291)."""
+from ..core.place import (
+    set_device, get_device, CPUPlace, TPUPlace, Place,
+    is_compiled_with_cuda, is_compiled_with_tpu, get_device_place,
+)
+import jax as _jax
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in _jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in _jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def cuda_device_count():
+    return 0
+
+
+def tpu_device_count():
+    return len([d for d in _jax.devices()
+                if d.platform in ("tpu", "axon")])
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (cudaDeviceSynchronize
+    analog). jax dispatch is async; this drains it."""
+    (_jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Event:
+    """Minimal device event (reference platform/device_event.h)."""
+
+    def __init__(self, device=None, enable_timing=False):
+        self._t = None
+
+    def record(self):
+        import time
+
+        synchronize()
+        self._t = time.perf_counter()
+
+    def elapsed_time(self, end):
+        return (end._t - self._t) * 1000.0
+
+
+class Stream:
+    """Single-stream model: XLA orders ops; kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream()
+
+
+def set_stream(stream):
+    return stream

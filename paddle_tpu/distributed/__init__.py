@@ -1,0 +1,27 @@
+"""paddle.distributed (reference: python/paddle/distributed/__init__.py).
+
+TPU-native stack: jax.sharding.Mesh + XLA collectives over ICI/DCN
+replace NCCL rings; fleet's 4-D hybrid topology gains an SP axis
+(see SURVEY.md §2.2 / §5)."""
+from .env import (ParallelEnv, get_rank, get_world_size)
+from .mesh import (build_mesh, set_mesh, get_mesh, ensure_mesh, spec,
+                   named_sharding)
+from .collective import (
+    ReduceOp, all_reduce, broadcast, reduce, all_gather, scatter, alltoall,
+    all_to_all, send, recv, barrier, new_group, wait, get_group,
+    is_initialized,
+)
+from .parallel import init_parallel_env, DataParallel
+from . import fleet
+from .fleet import utils as _fleet_utils
+from .utils import global_scatter, global_gather
+from .spawn import spawn
+from . import sharding
+
+
+def get_backend():
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    return None

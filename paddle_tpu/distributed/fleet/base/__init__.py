@@ -1,0 +1,3 @@
+from . import topology
+from . import distributed_strategy
+from . import role_maker

@@ -1,0 +1,79 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py,
+2,022 LoC over framework/distributed_strategy.proto). Plain-Python
+config object with the same field surface (protobuf dropped: flags feed
+the jit/sharding harness directly)."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # comm/exec
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.sync_nccl_allreduce = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.without_graph_optimization = True
+        self.find_unused_parameters = False
+        # amp
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "custom_white_list": [],
+            "custom_black_list": [],
+            "use_pure_fp16": False,
+            "use_fp16_guard": False,
+            "use_bf16": True,
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # sharding (ZeRO)
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "dp_degree": 1, "stage": 1, "offload": False,
+            "segment_broadcast_MB": 32.0,
+        }
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        # tensor parallel
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # hybrid
+        self.hybrid_configs = {
+            "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        # large-batch optimizers
+        self.lamb = False
+        self.lamb_configs = {}
+        self.lars = False
+        self.lars_configs = {}
+        # localsgd / dgc (config parity; TPU path uses exact allreduce)
+        self.localsgd = False
+        self.localsgd_configs = {}
+        self.adaptive_localsgd = False
+        self.dgc = False
+        self.dgc_configs = {}
+        # misc
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.heter_ccl_mode = False
+        self.asp = False
+        self.qat = False
+        self.fp16_allreduce = False
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if not k.startswith("_")}
+        on = [k for k, v in fields.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

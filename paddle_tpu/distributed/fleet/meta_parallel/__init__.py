@@ -1,0 +1,14 @@
+"""fleet.meta_parallel (reference: fleet/meta_parallel/)."""
+from .parallel_layers.mp_layers import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .parallel_layers.pp_layers import (
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
+)
+from .parallel_layers.random import (
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .tensor_parallel import TensorParallel
+from .pipeline_parallel import PipelineParallel
+from .sharding_parallel import ShardingParallel

@@ -1,0 +1,160 @@
+"""Pipeline layer description (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py — PipelineLayer:132,
+SegmentLayers:63, SharedLayerDesc).
+
+TPU-native: PipelineLayer records the layer list and its segmentation
+into stages; parameters of stage s are tagged with a stage id that the
+jit harness maps onto the 'pp' mesh axis (layer-placement pipeline +
+lax.scan microbatch accumulation = GPipe schedule; GSPMD moves
+activations between stage submeshes automatically)."""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return self.layer_func.__name__
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference :63 — uniform or parameter-weighted segmentation."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        assert len(layers_desc) >= num_parts
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            pat = self.method.split(":", 1)[1]
+            weights = [1 if re.search(pat, str(d)) else 0
+                       for d in self.layers_desc]
+            return self._by_weights(weights)
+        # parameter-weighted
+        weights = []
+        for d in self.layers_desc:
+            weights.append(1)
+        return self._by_weights(weights)
+
+    def uniform(self, num_items, num_parts):
+        result = [0]
+        for p in range(1, num_parts + 1):
+            result.append((num_items * p) // num_parts)
+        return result
+
+    def _by_weights(self, weights):
+        total = sum(weights) or 1
+        target = total / self.num_parts
+        result = [0]
+        acc = 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * len(result) and len(result) < self.num_parts:
+                result.append(i + 1)
+        while len(result) < self.num_parts + 1:
+            result.append(len(weights))
+        result[-1] = len(weights)
+        return result
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._layers_desc = list(layers)
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        # single-controller: materialize ALL stages; each layer tagged
+        # with its stage so the pjit harness shards placement over 'pp'
+        built = []
+        self._shared_layers = {}
+        for i, d in enumerate(self._layers_desc):
+            stage = self._stage_of(i)
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    lay = self._shared_layers[d.layer_name]
+                else:
+                    lay = d.build_layer()
+                    self._shared_layers[d.layer_name] = lay
+                fwd = d.forward_func
+                built.append((lay, stage, fwd))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), stage, None))
+            elif isinstance(d, Layer):
+                built.append((d, stage, None))
+            elif callable(d):
+                built.append((d, stage, None))
+            else:
+                raise TypeError(f"bad layer desc {d!r}")
+        self.run_function = []
+        layer_objs = []
+        for idx, (lay, stage, fwd) in enumerate(built):
+            self.run_function.append((lay, stage, fwd))
+            if isinstance(lay, Layer):
+                layer_objs.append(lay)
+                for _, p in lay.named_parameters():
+                    p.pp_stage = stage
+        self._layers = LayerList(layer_objs)
+
+    def _stage_of(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def get_stage_from_index(self, layer_idx):
+        return self._stage_of(layer_idx)
+
+    def forward(self, input):
+        x = input
+        for lay, stage, fwd in self.run_function:
+            if fwd is not None:
+                x = fwd(lay, x)
+            elif isinstance(lay, Layer) or callable(lay):
+                x = lay(x)
+        return x
+
+    @property
+    def parameters_by_stage(self):
+        out = {}
+        for lay, stage, _ in self.run_function:
+            if isinstance(lay, Layer):
+                for name, p in lay.named_parameters():
+                    out.setdefault(stage, []).append(p)
+        return out

@@ -1,0 +1,70 @@
+"""Model-parallel RNG tracking (reference:
+fleet/meta_parallel/parallel_layers/random.py — RNGStatesTracker:32).
+
+TPU-native: stateless keys — each tracked state is a distinct fold of
+the base key, so 'local_seed' (different per mp rank) vs 'global_seed'
+(same across mp) reduces to folding in the mesh coordinate."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....ops import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = (jax.random.key(seed), 0)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            yield
+            return
+        base, counter = self.states_[name]
+        saved = (_random._rng.base, _random._rng.counter)
+        _random._rng.base, _random._rng.counter = base, counter
+        try:
+            yield
+        finally:
+            self.states_[name] = (_random._rng.base, _random._rng.counter)
+            _random._rng.base, _random._rng.counter = saved
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from ...base.topology import HybridCommunicateGroup
+
+    seed = seed or (pyrandom.randint(0, 2 ** 20))
+    global_seed = seed
+    local_seed = seed + 1024 + 1  # + mp rank in multi-controller
+    _tracker.reset()
+    _tracker.add("global_seed", global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)

@@ -1,0 +1,81 @@
+"""PipelineParallel (reference: meta_parallel/pipeline_parallel.py —
+forward_backward_pipeline:80-150 1F1B; p2p via
+pp_utils/p2p_communication.py).
+
+TPU-native: train_batch splits the batch into micro-batches and
+accumulates gradients (GPipe schedule). Compiled over a mesh with a
+'pp' axis, stage parameters live on their stage's submesh and XLA
+pipelines the micro-batch loop across stages via ICI transfers —
+replacing send_v2/recv_v2 ops."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....core.engine import no_grad
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self.total_loss = None
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """micro-batched fwd/bwd with gradient accumulation (GPipe)."""
+        inputs, labels = data
+        n_micro = self.accumulate_steps
+        losses = []
+        from ....ops.manipulation import split
+
+        micro_inputs = split(inputs, n_micro, axis=0) if n_micro > 1 else [inputs]
+        micro_labels = split(labels, n_micro, axis=0) if n_micro > 1 else [labels]
+        for mi, ml in zip(micro_inputs, micro_labels):
+            out = self._layers(mi)
+            loss = self._layers._loss_fn(out, ml)
+            scaled = loss.scale(1.0 / n_micro)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            losses.append(float(loss.item()))
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.float32(np.mean(losses)))
+
+    @no_grad()
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

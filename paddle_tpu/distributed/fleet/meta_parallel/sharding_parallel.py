@@ -1,0 +1,31 @@
+"""ShardingParallel wrapper (reference:
+meta_parallel/sharding_parallel.py). Tags params for ZeRO sharding over
+the 'sharding' mesh axis; the compiled step keeps optimizer states
+sharded (reduce-scatter/all-gather pattern from GSPMD)."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        from ...sharding import group_sharded_parallel
+
+        group_sharded_parallel(layers, optimizer=None)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

@@ -1,0 +1,3 @@
+from .fs import LocalFS, FS
+from . import recompute as _recompute_mod
+from .recompute import recompute

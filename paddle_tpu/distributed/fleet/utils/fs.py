@@ -1,0 +1,99 @@
+"""Filesystem abstraction (reference: fleet/utils/fs.py:57 — FS base,
+LocalFS, HDFSClient). HDFS needs an external hadoop client; LocalFS is
+the complete TPU-pod path (checkpoints to NFS/GCS-fuse mounts)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def mv(self, src, dst, overwrite=False, test_exists=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        raise NotImplementedError(
+            "HDFSClient requires an external hadoop CLI; on TPU pods use "
+            "LocalFS over NFS/gcsfuse mounts.")

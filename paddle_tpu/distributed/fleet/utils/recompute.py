@@ -1,0 +1,78 @@
+"""Recompute / activation checkpointing (reference:
+fleet/utils/recompute.py RecomputeFunction; fluid/backward.py:760).
+
+TPU-native: in compiled (to_static/TrainStepCompiler) code this maps to
+`jax.checkpoint` (rematerialization — XLA recomputes the segment in the
+backward pass, trading FLOPs for HBM exactly like the reference).
+Dygraph eager: forward runs under no_grad, and backward re-runs it with
+the tape enabled via a PyLayer."""
+from __future__ import annotations
+
+import jax
+
+from ....autograd.py_layer import PyLayer
+from ....core import engine
+from ....core.tensor import Tensor
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if engine.in_trace_mode():
+        # compiled path: jax.checkpoint the pure segment
+        from jax import tree_util
+
+        flat, treedef = tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, Tensor))
+        vals = [a._value if isinstance(a, Tensor) else a for a in flat]
+
+        def pure(vals_):
+            leaves = [Tensor(v, stop_gradient=False, _internal=True)
+                      if hasattr(v, "dtype") else v for v in vals_]
+            args_ = tree_util.tree_unflatten(treedef, leaves)
+            out = function(*args_, **kwargs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o._value for o in outs]
+
+        out_vals = jax.checkpoint(pure)(vals)
+        outs = [Tensor(v, stop_gradient=False, _internal=True)
+                for v in out_vals]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *tensors):
+            ctx.save_for_backward(*tensors)
+            ctx.kwargs = kwargs
+            from ....ops import random as _random
+
+            ctx.rng_state = _random.get_rng_state()
+            with engine.no_grad():
+                out = function(*tensors, **kwargs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            from ....ops import random as _random
+
+            saved = ctx.saved_tensor
+            detached = [t.detach() for t in saved]
+            for t in detached:
+                t.stop_gradient = False
+            if preserve_rng_state:
+                prev = _random.get_rng_state()
+                _random.set_rng_state(ctx.rng_state)
+            with engine.enable_grad():
+                out = function(*detached, **ctx.kwargs)
+            if preserve_rng_state:
+                _random.set_rng_state(prev)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            from ....core.engine import grad as grad_fn
+
+            gs = grad_fn(list(outs), detached, grad_outputs=list(grads),
+                         allow_unused=True)
+            return tuple(gs)
+
+    return _Recompute.apply(*args)

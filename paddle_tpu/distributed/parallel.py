@@ -1,0 +1,66 @@
+"""Dygraph data parallel (reference: python/paddle/distributed/parallel.py
+init_parallel_env:79; python/paddle/fluid/dygraph/parallel.py
+DataParallel:397 + C++ Reducer imperative/reducer.h:126).
+
+TPU-native: single-controller SPMD means dygraph arrays are global —
+gradient averaging across data-parallel replicas happens inside the
+compiled train step via sharding (GSPMD inserts the all-reduce over
+ICI). DataParallel therefore wraps the layer, tags parameters as
+replicated, and the jit path does bucketed-allreduce-equivalent comm
+automatically (XLA fuses gradient all-reduces — the analog of the
+Reducer's fused buckets)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+from . import mesh as mesh_mod
+from .env import ParallelEnv, get_rank, get_world_size
+
+
+def init_parallel_env():
+    """Bootstrap: build the default data-parallel mesh over all devices."""
+    mesh_mod.ensure_mesh(dp=-1)
+    return ParallelEnv()
+
+
+def get_device_mesh():
+    return mesh_mod.get_mesh()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.comm_buffer_size = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+        for _, p in layers.named_parameters():
+            p.dist_spec = None  # replicated
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

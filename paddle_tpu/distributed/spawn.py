@@ -1,0 +1,15 @@
+"""paddle.distributed.spawn (reference: python/paddle/distributed/spawn.py).
+Single-controller SPMD: JAX owns all local devices in one process, so
+spawn degenerates to running the function once (nprocs>1 with separate
+processes would fight over the TPU). Multi-host uses one process per
+host, launched externally (launch module)."""
+from __future__ import annotations
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    func(*args)
+
+
+class ProcessContext:
+    def join(self):
+        return True

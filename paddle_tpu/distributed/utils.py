@@ -1,0 +1,34 @@
+"""MoE expert-parallel primitives (reference:
+python/paddle/distributed/utils.py global_scatter:57 / global_gather:179
+over operators/collective/global_scatter_op.cc).
+
+TPU-native: token routing is an all_to_all over the expert-parallel
+mesh axis inside compiled steps; eager single-controller keeps the
+global token tensor and permutes locally."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _k_identity(v):
+    return v + 0
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Route rows of x to experts. Single-controller: the token tensor is
+    already global, so routing is the identity here; the expert-parallel
+    all_to_all happens inside compiled steps (collective.alltoall over
+    the 'ep' axis)."""
+    return apply_op("global_scatter", _k_identity, x)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    return apply_op("global_gather", _k_identity, x)

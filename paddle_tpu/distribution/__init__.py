@@ -1,0 +1,419 @@
+"""paddle.distribution (reference: python/paddle/distribution.py —
+Distribution/Normal/Uniform/Categorical + kl_divergence)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops import random as _random
+from ..core.engine import apply_op
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+           "Dirichlet", "ExponentialFamily", "Multinomial", "Bernoulli",
+           "LogNormal", "Gumbel", "Laplace", "Geometric", "Cauchy",
+           "kl_divergence", "register_kl"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x, np.float32))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from ..ops.math import square
+
+        return square(self.scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)))
+        key = _random.next_key()
+
+        def _k(loc, scale, key, shape):
+            return loc + scale * jax.random.normal(key, shape,
+                                                   dtype=jnp.float32)
+
+        return apply_op("normal_sample", _k, self.loc, self.scale, key=key,
+                        shape=shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _k(loc, scale, v):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply_op("normal_log_prob", _k, self.loc, self.scale,
+                        _t(value))
+
+    def entropy(self):
+        def _k(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+
+        return apply_op("normal_entropy", _k, self.scale)
+
+    def kl_divergence(self, other):
+        def _k(l1, s1, l2, s2):
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+        return apply_op("normal_kl", _k, self.loc, self.scale, other.loc,
+                        other.scale)
+
+
+class LogNormal(Normal):
+    def sample(self, shape=(), seed=0):
+        from ..ops.math import exp
+
+        return exp(super().sample(shape, seed))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(self.low.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape)))
+        key = _random.next_key()
+
+        def _k(lo, hi, key, shape):
+            return lo + (hi - lo) * jax.random.uniform(key, shape,
+                                                       dtype=jnp.float32)
+
+        return apply_op("uniform_sample", _k, self.low, self.high, key=key,
+                        shape=shape)
+
+    def log_prob(self, value):
+        def _k(lo, hi, v):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply_op("uniform_log_prob", _k, self.low, self.high,
+                        _t(value))
+
+    def entropy(self):
+        def _k(lo, hi):
+            return jnp.log(hi - lo)
+
+        return apply_op("uniform_entropy", _k, self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(logits, key, shape):
+            return jax.random.categorical(key, logits,
+                                          shape=tuple(shape)
+                                          + logits.shape[:-1])
+
+        return apply_op("categorical_sample", _k, self.logits, key=key,
+                        shape=tuple(shape))
+
+    def _probs(self):
+        def _k(logits):
+            return jax.nn.softmax(logits, axis=-1)
+
+        return apply_op("categorical_probs", _k, self.logits)
+
+    @property
+    def probs(self):
+        return self._probs()
+
+    def log_prob(self, value):
+        def _k(logits, v):
+            lsm = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.take_along_axis(
+                lsm, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+        return apply_op("categorical_log_prob", _k, self.logits, _t(value))
+
+    def entropy(self):
+        def _k(logits):
+            p = jax.nn.softmax(logits, axis=-1)
+            lsm = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(p * lsm, axis=-1)
+
+        return apply_op("categorical_entropy", _k, self.logits)
+
+    def kl_divergence(self, other):
+        def _k(l1, l2):
+            p = jax.nn.softmax(l1, axis=-1)
+            return jnp.sum(p * (jax.nn.log_softmax(l1, axis=-1)
+                                - jax.nn.log_softmax(l2, axis=-1)), axis=-1)
+
+        return apply_op("categorical_kl", _k, self.logits, other.logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = _t(probs)
+        super().__init__(tuple(self.probs_t.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(p, key, shape):
+            return jax.random.bernoulli(
+                key, p, tuple(shape) + p.shape).astype(jnp.float32)
+
+        return apply_op("bernoulli_sample", _k, self.probs_t, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op("bernoulli_log_prob", _k, self.probs_t, _t(value))
+
+    def entropy(self):
+        def _k(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply_op("bernoulli_entropy", _k, self.probs_t)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(self.alpha.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(a, b, key, shape):
+            return jax.random.beta(key, a, b, tuple(shape) + a.shape)
+
+        return apply_op("beta_sample", _k, self.alpha, self.beta, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(a, b, v):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b)))
+
+        return apply_op("beta_log_prob", _k, self.alpha, self.beta, _t(value))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(c, key, shape):
+            return jax.random.dirichlet(key, c, tuple(shape) + c.shape[:-1])
+
+        return apply_op("dirichlet_sample", _k, self.concentration, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(c, v):
+            return (jnp.sum((c - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, axis=-1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), axis=-1))
+
+        return apply_op("dirichlet_log_prob", _k, self.concentration,
+                        _t(value))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_t = _t(probs)
+        super().__init__(tuple(self.probs_t.shape[:-1]),
+                         tuple(self.probs_t.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        n = self.total_count
+
+        def _k(p, key, shape, n):
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+            draws = jax.random.categorical(
+                key, logits, shape=(n,) + tuple(shape) + p.shape[:-1])
+            onehot = jax.nn.one_hot(draws, p.shape[-1])
+            return jnp.sum(onehot, axis=0)
+
+        return apply_op("multinomial_sample", _k, self.probs_t, key=key,
+                        shape=tuple(shape), n=n)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(loc, scale, key, shape):
+            return loc + scale * jax.random.gumbel(
+                key, tuple(shape) + loc.shape, dtype=jnp.float32)
+
+        return apply_op("gumbel_sample", _k, self.loc, self.scale, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(loc, scale, v):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+        return apply_op("gumbel_log_prob", _k, self.loc, self.scale,
+                        _t(value))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(loc, scale, key, shape):
+            return loc + scale * jax.random.laplace(
+                key, tuple(shape) + loc.shape, dtype=jnp.float32)
+
+        return apply_op("laplace_sample", _k, self.loc, self.scale, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(loc, scale, v):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+        return apply_op("laplace_log_prob", _k, self.loc, self.scale,
+                        _t(value))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_t = _t(probs)
+        super().__init__(tuple(self.probs_t.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(p, key, shape):
+            return jax.random.geometric(key, p, tuple(shape) + p.shape)
+
+        return apply_op("geometric_sample", _k, self.probs_t, key=key,
+                        shape=tuple(shape))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(self.loc.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+
+        def _k(loc, scale, key, shape):
+            return loc + scale * jax.random.cauchy(
+                key, tuple(shape) + loc.shape, dtype=jnp.float32)
+
+        return apply_op("cauchy_sample", _k, self.loc, self.scale, key=key,
+                        shape=tuple(shape))
+
+    def log_prob(self, value):
+        def _k(loc, scale, v):
+            z = (v - loc) / scale
+            return -jnp.log(math.pi * scale * (1 + z * z))
+
+        return apply_op("cauchy_log_prob", _k, self.loc, self.scale,
+                        _t(value))
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if hasattr(p, "kl_divergence") and type(p) is type(q):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for {type(p).__name__}/"
+        f"{type(q).__name__}")

@@ -1,0 +1,69 @@
+"""paddle.fft (reference: python/paddle/fft.py over spectral_op; here
+jnp.fft → XLA FFT)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.engine import apply_op
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(name, jfn, has_n=True):
+    if has_n:
+        def op(x, n=None, axis=-1, norm="backward", name=None,
+               _jfn=jfn, _n=name):
+            return apply_op(_n, lambda v, n, axis, norm: _jfn(
+                v, n=n, axis=axis, norm=norm), x, n=n, axis=axis, norm=norm)
+    else:
+        def op(x, s=None, axes=None, norm="backward", name=None,
+               _jfn=jfn, _n=name):
+            return apply_op(_n, lambda v, s, axes, norm: _jfn(
+                v, s=s, axes=axes, norm=norm), x, s=s,
+                axes=tuple(axes) if axes is not None else None, norm=norm)
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+fft2 = _mk("fft2", jnp.fft.fft2, has_n=False)
+ifft2 = _mk("ifft2", jnp.fft.ifft2, has_n=False)
+rfft2 = _mk("rfft2", jnp.fft.rfft2, has_n=False)
+irfft2 = _mk("irfft2", jnp.fft.irfft2, has_n=False)
+fftn = _mk("fftn", jnp.fft.fftn, has_n=False)
+ifftn = _mk("ifftn", jnp.fft.ifftn, has_n=False)
+rfftn = _mk("rfftn", jnp.fft.rfftn, has_n=False)
+irfftn = _mk("irfftn", jnp.fft.irfftn, has_n=False)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import to_tensor
+    import numpy as np
+
+    return to_tensor(np.fft.fftfreq(int(n), d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import to_tensor
+    import numpy as np
+
+    return to_tensor(np.fft.rfftfreq(int(n), d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift",
+                    lambda v, axes: jnp.fft.fftshift(v, axes=axes),
+                    x, axes=tuple(axes) if axes is not None else None)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift",
+                    lambda v, axes: jnp.fft.ifftshift(v, axes=axes),
+                    x, axes=tuple(axes) if axes is not None else None)

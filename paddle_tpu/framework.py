@@ -1,0 +1,84 @@
+"""Framework-level helpers: save/load, default dtype, in_dygraph_mode.
+
+Parity target: python/paddle/framework/io.py (paddle.save/load:553,769),
+python/paddle/framework/framework.py (set_default_dtype).
+
+TPU-native: checkpoints are pickled nested dicts of numpy arrays —
+device-agnostic and portable; tensors are materialized host-side at
+save and re-placed on the current device at load. (The reference
+pickles LoDTensor protocol buffers.)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import flags
+from .core.dtype import convert_dtype, dtype_name
+from .core.tensor import Tensor
+
+
+def set_default_dtype(d):
+    flags.set_flags({"default_dtype": dtype_name(convert_dtype(d))})
+
+
+def get_default_dtype():
+    return flags.get_flag("default_dtype")
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _SavedTensor(np.asarray(obj._value))
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _SavedTensor:
+    """Tag so load() can rehydrate Tensors (vs plain ndarrays)."""
+
+    def __init__(self, array):
+        self.array = array
+
+
+def _from_saved(obj, return_numpy=False):
+    if isinstance(obj, _SavedTensor):
+        if return_numpy:
+            return obj.array
+        return Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save — state_dicts / nested containers of Tensors."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy=return_numpy)
+
+
+def in_dygraph_mode():
+    from . import static
+
+    return not static._static_mode()
+
+
+_dygraph_tracer = lambda: None

@@ -1,0 +1,2 @@
+from .model import Model, summary, flops
+from . import callbacks

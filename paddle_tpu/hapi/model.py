@@ -1,0 +1,289 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py —
+prepare:906/fit:1485/evaluate:1556/predict:1786).
+
+TPU-native: train_batch runs through jit.TrainStepCompiler when the
+model/loss/optimizer triple allows it (single scalar loss), falling
+back to dygraph tape otherwise."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.engine import no_grad
+from ..io import DataLoader, Dataset
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._compiled_step = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        return self
+
+    # -- single-batch APIs ------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        if self._compiled_step is None and update and self._loss is not None:
+            try:
+                from ..jit import TrainStepCompiler
+
+                net = self.network
+                loss_fn = self._loss
+
+                def model_fn(*args):
+                    return net(*args)
+
+                self._compiled_step = TrainStepCompiler(
+                    net, self._optimizer,
+                    lambda out, lbl: self._compute_loss(out, [lbl]))
+            except Exception:
+                self._compiled_step = False
+        if self._compiled_step:
+            avals = [x._value for x in inputs] + [l._value for l in labels]
+            try:
+                loss = self._compiled_step(*avals)
+                return [float(loss.item())]
+            except Exception:
+                self._compiled_step = False
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        if update:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.item())]
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if callable(self._loss):
+            return self._loss(*outs, *labels)
+        raise ValueError("Model.prepare(loss=...) required for training")
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(outputs if not isinstance(outputs, (list, tuple))
+                            else outputs[0], *labels)
+            m.update(res)
+            metrics.append(m.accumulate())
+        return [float(loss.item())], metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        out = self.network(*inputs)
+        return [o.numpy() for o in (out if isinstance(out, (list, tuple))
+                                    else [out])]
+
+    # -- loops ------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        eval_loader = (self._as_loader(eval_data, batch_size, False, False,
+                                       num_workers)
+                       if eval_data is not None else None)
+        cbks = cb_mod.config_callbacks(callbacks, model=self,
+                                       epochs=epochs,
+                                       steps=self._safe_len(loader),
+                                       log_freq=log_freq,
+                                       save_dir=save_dir,
+                                       verbose=verbose,
+                                       metrics=["loss"] + [
+                                           m.name() for m in self._metrics])
+        cbks.on_begin("train")
+        iters_done = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, None)
+                ins, lbls = self._split_batch(batch)
+                loss = self.train_batch(ins, lbls)
+                logs = {"loss": loss[0], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, {"loss": loss[0]})
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
+            if num_iters is not None and iters_done >= num_iters:
+                break
+        cbks.on_end("train")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, lbls = self._split_batch(batch)
+            loss, _ = self.eval_batch(ins, lbls)
+            losses.append(loss[0])
+        out = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            out[m.name() if isinstance(m.name(), str) else "metric"] = \
+                m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import framework
+
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework
+        import os
+
+        state = framework.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(framework.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        if isinstance(x, (list, tuple)):
+            return list(x)
+        return [x]
+
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data
+
+    @staticmethod
+    def _split_batch(batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if has_label and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary (reference: hapi/model_summary.py)."""
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total_params += n
+        if p.trainable:
+            trainable_params += n
+        rows.append((name, tuple(p.shape), n))
+    lines = [f"{'Param':<50s}{'Shape':<24s}{'Count':>12s}"]
+    lines += [f"{n:<50s}{str(s):<24s}{c:>12,d}" for n, s, c in rows]
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops — analytic conv/linear FLOPs estimate."""
+    from ..nn import Conv2D, Linear
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, output):
+        x = inputs[0]
+        out = output
+        kh, kw = layer._kernel_size
+        cin = layer._in_channels // layer._groups
+        total[0] += (2 * kh * kw * cin * int(np.prod(out.shape[1:])))
+
+    def linear_hook(layer, inputs, output):
+        total[0] += 2 * layer._in_features * layer._out_features * \
+            int(np.prod(output.shape[:-1]))
+
+    for lay in net.sublayers(include_self=True):
+        if isinstance(lay, Conv2D):
+            hooks.append(lay.register_forward_post_hook(conv_hook))
+        elif isinstance(lay, Linear):
+            hooks.append(lay.register_forward_post_hook(linear_hook))
+    from ..ops.creation import zeros
+
+    x = zeros(list(input_size))
+    net.eval()
+    with no_grad():
+        net(x)
+    for h in hooks:
+        h.remove()
+    return total[0]

@@ -1,0 +1,36 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py). Zero-egress
+environment: local-dir loading only; remote sources raise."""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_entry(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):
+    if source != "local":
+        raise RuntimeError("remote hub sources unavailable (no egress)")
+    mod = _load_entry(repo_dir)
+    return [n for n in dir(mod) if callable(getattr(mod, n))
+            and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    mod = _load_entry(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise RuntimeError("remote hub sources unavailable (no egress)")
+    mod = _load_entry(repo_dir)
+    return getattr(mod, model)(**kwargs)

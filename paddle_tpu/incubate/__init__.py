@@ -1,0 +1,3 @@
+"""paddle.incubate (reference: python/paddle/incubate/)."""
+from . import nn
+from . import autograd

@@ -1,0 +1,2 @@
+"""paddle.incubate.autograd — re-export of functional autodiff."""
+from ..autograd.functional import jacobian, hessian, vjp, jvp

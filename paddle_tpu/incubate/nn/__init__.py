@@ -1,0 +1,9 @@
+"""paddle.incubate.nn — fused transformer blocks (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py:25,216,348)."""
+from . import attention
+from .layer.fused_transformer import (
+    FusedMultiHeadAttention,
+    FusedFeedForward,
+    FusedTransformerEncoderLayer,
+)
+from . import functional

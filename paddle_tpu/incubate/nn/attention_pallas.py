@@ -1,0 +1,136 @@
+"""Pallas TPU flash-attention kernel.
+
+Replaces the reference's fused CUDA attention
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with a
+TPU-native tiled kernel: online-softmax over KV tiles held in VMEM, so
+the [S, S] score matrix never materializes in HBM; QK^T and PV ride the
+MXU in fp32 accumulation. Forward is Pallas; backward is a custom-VJP
+recompute in XLA (einsum chain, fully fused) — flash backward kernel is
+a planned upgrade.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
+               block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [BQ, D]
+    bq, d = q.shape
+    num_kv = seq_k // block_k
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+
+    if causal:
+        # only iterate kv blocks at-or-below this q block's diagonal
+        upper = jnp.minimum(num_kv, (qi + 1) * block_q // block_k
+                            + (1 if block_q % block_k else 0))
+        upper = jnp.maximum(upper, 1)
+        acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_fa_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=bq, block_k=bk, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+def _attn_ref(q, k, v, causal, sm_scale):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return p, jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, sm_scale=1.0,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v = res
+    p, _ = _attn_ref(q, k, v, causal, sm_scale)
+    p = p.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,16 @@
+"""paddle.incubate.nn.functional (reference:
+python/paddle/incubate/nn/functional/)."""
+from ..attention import scaled_dot_product_attention
+from ....nn.functional import (
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "use incubate.nn.FusedMultiHeadAttention (layer API)")
+
+
+def fused_feedforward(*args, **kwargs):
+    raise NotImplementedError("use incubate.nn.FusedFeedForward (layer API)")

@@ -1,0 +1,357 @@
+"""paddle.jit — dygraph→static compilation.
+
+Parity target: @to_static / ProgramTranslator
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:775,
+fluid/dygraph/jit.py).
+
+TPU-native design: instead of AST rewriting into a Program, the
+function is *traced with jax*: parameters' storage is temporarily bound
+to tracers, the same Python code runs, and the result is one XLA
+computation. `jax.jit` caches per input signature — the analog of
+ConcreteProgram caching per InputSpec. `TrainStepCompiler` additionally
+closes the loop: forward+backward+optimizer update in ONE compiled,
+buffer-donated XLA program (the fastest possible step on TPU).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from ..core import engine
+from ..core.tensor import Tensor
+from ..ops import random as _random
+from . import state as _jstate
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TracedLayer",
+           "TrainStepCompiler", "InputSpec"]
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _collect_layers(func, args):
+    """Find Layer objects whose parameters the traced fn may touch."""
+    from ..nn import Layer
+
+    layers = []
+    seen = set()
+
+    def add(obj):
+        if isinstance(obj, Layer) and id(obj) not in seen:
+            seen.add(id(obj))
+            layers.append(obj)
+
+    add(getattr(func, "__self__", None))
+    if inspect.isfunction(func) or inspect.ismethod(func):
+        closure = getattr(func, "__closure__", None)
+        if closure:
+            for cell in closure:
+                try:
+                    add(cell.cell_contents)
+                except ValueError:
+                    pass
+        for v in getattr(func, "__globals__", {}).values() if False else []:
+            pass
+    for a in args:
+        add(a)
+    return layers
+
+
+class StaticFunction:
+    """Compiled wrapper (reference: StaticFunction,
+    program_translator.py:236)."""
+
+    def __init__(self, func, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._func = func
+        self._input_spec = input_spec
+        self._compiled = {}
+        functools.update_wrapper(self, func,
+                                 assigned=("__name__", "__doc__"))
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._func.__get__(instance, owner),
+                               self._input_spec)
+        bound._compiled = self._compiled
+        return bound
+
+    @property
+    def dygraph_function(self):
+        return self._func
+
+    def __call__(self, *args, **kwargs):
+        from ..nn import Layer
+
+        target = self._func
+        layers = _collect_layers(target, args)
+        params = []
+        for lay in layers:
+            params.extend(p for _, p in lay.named_parameters())
+            params.extend(b for _, b in lay.named_buffers())
+        param_ids = [id(p) for p in params]
+
+        flat_args, args_treedef = tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_pos = [i for i, a in enumerate(flat_args)
+                      if isinstance(a, Tensor)]
+        static_leaves = [None if isinstance(a, Tensor) else a
+                         for a in flat_args]
+
+        key = (args_treedef, tuple(tensor_pos),
+               tuple((tuple(flat_args[i].shape), str(flat_args[i].dtype))
+                     for i in tensor_pos), tuple(param_ids))
+        entry = self._compiled.get(key)
+        if entry is None:
+            entry = self._build(target, params, args_treedef, tensor_pos,
+                                static_leaves)
+            self._compiled[key] = entry
+        jfn = entry
+        pvals = [p._value for p in params]
+        avals = [flat_args[i]._value for i in tensor_pos]
+        rngc = jnp.asarray(_random._rng.counter, jnp.uint32)
+        out_vals, new_buf_vals, out_treedef_box = jfn(pvals, avals, rngc)
+        _random._rng.counter += 1
+        # commit buffer updates (BatchNorm stats)
+        for (buf, _), nv in zip(out_treedef_box["buf_refs"], new_buf_vals):
+            buf._value = nv
+        flat_out = [Tensor(v, stop_gradient=True, _internal=True)
+                    for v in out_vals]
+        return tree_util.tree_unflatten(out_treedef_box["treedef"], flat_out)
+
+    def _build(self, target, params, args_treedef, tensor_pos,
+               static_leaves):
+        box = {}
+
+        @jax.jit
+        def jfn(pvals, avals, rng_counter):
+            with engine.trace_mode():
+                prev_key = _random.push_traced_key(
+                    jax.random.fold_in(_random._rng.base, rng_counter))
+                try:
+                    for p, v in zip(params, pvals):
+                        p.__dict__["_saved_value"] = p._value
+                        p._value = v
+                    leaves = list(static_leaves)
+                    for i, pos in enumerate(tensor_pos):
+                        leaves[pos] = Tensor(avals[i], stop_gradient=True,
+                                             _internal=True)
+                    args, kwargs = tree_util.tree_unflatten(args_treedef,
+                                                            leaves)
+                    scope = _jstate.push_buffer_scope()
+                    out = target(*args, **kwargs)
+                    _jstate.pop_buffer_scope()
+                    flat_out, treedef = tree_util.tree_flatten(
+                        out, is_leaf=lambda x: isinstance(x, Tensor))
+                    out_vals = [o._value if isinstance(o, Tensor) else o
+                                for o in flat_out]
+                    box["treedef"] = treedef
+                    box["buf_refs"] = scope
+                    new_bufs = [nv._value for (_, nv) in scope]
+                    return out_vals, new_bufs, {}
+                finally:
+                    for p in params:
+                        sv = p.__dict__.pop("_saved_value", None)
+                        if sv is not None:
+                            p._value = sv
+                    _random.pop_traced_key(prev_key)
+
+        def call(pvals, avals, rngc):
+            out_vals, new_bufs, _ = jfn(pvals, avals, rngc)
+            return out_vals, new_bufs, box
+
+        return call
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling a dygraph callable with XLA."""
+    from ..nn import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(func):
+    func._not_to_static = True
+    return func
+
+
+class TracedLayer:
+    def __init__(self, layer, out):
+        self._layer = layer
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        return out, TracedLayer(layer, out)
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save — persists state_dict + a marker (program serialization
+    of compiled executables is planned; reference jit.save writes
+    a Program + params)."""
+    from .. import framework
+
+    framework.save(layer.state_dict(), path + ".pdparams")
+    meta = {"class": type(layer).__name__,
+            "input_spec": [repr(s) for s in (input_spec or [])]}
+    import json
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(meta, f)
+
+
+def load(path, **configs):
+    from .. import framework
+
+    state = framework.load(path + ".pdparams")
+
+    class TranslatedLayer:
+        def __init__(self, state):
+            self._state = state
+
+        def state_dict(self):
+            return self._state
+
+    return TranslatedLayer(state)
+
+
+class TrainStepCompiler:
+    """Whole-train-step compiler: loss_fn(model outputs) + optimizer
+    update in one donated XLA program. This is the TPU performance
+    path — analog of CompiledProgram+fused optimizer in the reference
+    (compiler.py, ParallelExecutor), but stronger: fwd+bwd+update fuse.
+
+    usage:
+        step = TrainStepCompiler(model, opt, loss_fn)
+        loss = step(x, y)          # updates model params in place
+    """
+
+    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+        self._model = model
+        self._opt = optimizer
+        self._loss_fn = loss_fn
+        self._donate = donate
+        self._compiled = None
+        self._names = None
+        self._opt_state = None
+        self._step = 0
+
+    def _params_and_buffers(self):
+        params = dict(self._model.named_parameters())
+        bufs = dict(self._model.named_buffers())
+        trainable = {k: p for k, p in params.items() if p.trainable}
+        frozen = {k: p for k, p in params.items() if not p.trainable}
+        return trainable, frozen, bufs
+
+    def __call__(self, *batch):
+        trainable, frozen, bufs = self._params_and_buffers()
+        if self._compiled is None:
+            self._build(trainable, frozen, bufs, batch)
+        pvals = {k: p._value for k, p in trainable.items()}
+        fvals = {k: p._value for k, p in frozen.items()}
+        bvals = {k: b._value for k, b in bufs.items()}
+        avals = tuple(b._value if isinstance(b, Tensor) else b
+                      for b in batch)
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        rngc = jnp.asarray(self._step, jnp.uint32)
+        new_p, new_opt, new_b, loss = self._compiled(
+            pvals, self._opt_state, fvals, bvals, avals, lr, rngc)
+        self._opt_state = new_opt
+        for k, p in trainable.items():
+            p._value = new_p[k]
+        for k, b in bufs.items():
+            b._value = new_b[k]
+        self._step += 1
+        self._opt._step_count += 1
+        from ..optimizer.lr import LRScheduler
+
+        return Tensor(loss, stop_gradient=True, _internal=True)
+
+    def _build(self, trainable, frozen, bufs, batch):
+        model = self._model
+        loss_fn = self._loss_fn
+        opt = self._opt
+        t_items = list(trainable.items())
+        f_items = list(frozen.items())
+        b_items = list(bufs.items())
+        self._opt_state = opt.init_state(
+            {k: p._value for k, p in t_items})
+
+        def loss_of(pvals, fvals, bvals, avals, rngc):
+            with engine.trace_mode():
+                prev_key = _random.push_traced_key(
+                    jax.random.fold_in(_random._rng.base, rngc))
+                saved = []
+                try:
+                    for (k, p) in t_items:
+                        saved.append((p, p._value))
+                        p._value = pvals[k]
+                    for (k, p) in f_items:
+                        saved.append((p, p._value))
+                        p._value = fvals[k]
+                    for (k, b) in b_items:
+                        saved.append((b, b._value))
+                        b._value = bvals[k]
+                    scope = _jstate.push_buffer_scope()
+                    args = [Tensor(a, stop_gradient=True, _internal=True)
+                            if isinstance(a, jax.Array) or isinstance(
+                                a, jnp.ndarray) else a for a in avals]
+                    if loss_fn is not None:
+                        out = model(*args[:-1])
+                        loss = loss_fn(out, args[-1])
+                    else:
+                        loss = model(*args)
+                    _jstate.pop_buffer_scope()
+                    id2key = {id(b): k for k, b in b_items}
+                    new_bvals = dict(bvals)
+                    for buf, nv in scope:
+                        kk = id2key.get(id(buf))
+                        if kk is not None:
+                            new_bvals[kk] = nv._value
+                    lv = loss._value if isinstance(loss, Tensor) else loss
+                    return lv.astype(jnp.float32), new_bvals
+                finally:
+                    for obj, v in saved:
+                        obj._value = v
+                    _random.pop_traced_key(prev_key)
+
+        def step_fn(pvals, opt_state, fvals, bvals, avals, lr, rngc):
+            (loss, new_bvals), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pvals, fvals, bvals, avals, rngc)
+            new_p, new_s = opt.apply_gradients(pvals, grads, opt_state, lr)
+            return new_p, new_s, new_bvals, loss
+
+        donate = (0, 1) if self._donate else ()
+        self._compiled = jax.jit(step_fn, donate_argnums=donate)

@@ -1,0 +1,33 @@
+"""Buffer-update side channel for jit tracing.
+
+Stateful layers (BatchNorm running stats) mutate buffers in dygraph;
+under jit tracing mutation is illegal, so updates are recorded here and
+the jit harness threads them out as extra outputs, committing them
+after each compiled step (the functional analog of the reference's
+in-place running-stat ops)."""
+from __future__ import annotations
+
+import threading
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _State()
+
+
+def push_buffer_scope():
+    scope = []
+    _state.stack.append(scope)
+    return scope
+
+
+def pop_buffer_scope():
+    return _state.stack.pop()
+
+
+def record_buffer_update(buffer_tensor, new_tensor):
+    if _state.stack:
+        _state.stack[-1].append((buffer_tensor, new_tensor))

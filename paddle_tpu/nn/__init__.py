@@ -1,0 +1,17 @@
+"""paddle.nn (reference: python/paddle/nn/__init__.py)."""
+from .layer.layers import Layer
+from .layer.common import *  # noqa
+from .layer.conv import *  # noqa
+from .layer.norm import *  # noqa
+from .layer.pooling import *  # noqa
+from .layer.activation import *  # noqa
+from .layer.container import *  # noqa
+from .layer.loss import *  # noqa
+from .layer.transformer import *  # noqa
+from .layer.rnn import *  # noqa
+from .layer.vision import *  # noqa
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+from .param_attr import ParamAttr
+from . import functional
+from . import initializer
+from . import utils

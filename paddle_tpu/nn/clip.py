@@ -1,0 +1,110 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm). Each clip has a
+dygraph path (list of (param, grad) Tensors) and a pure `functional_clip`
+(dict name→array) used inside jitted train steps."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def functional_clip(self, grads: dict):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(gv, self.min, self.max),
+                                  stop_gradient=True, _internal=True)))
+        return out
+
+    def functional_clip(self, grads):
+        return {k: jnp.clip(v, self.min, self.max) for k, v in grads.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor(self._clip_one(gv), stop_gradient=True,
+                                  _internal=True)))
+        return out
+
+    def functional_clip(self, grads):
+        return {k: self._clip_one(v) for k, v in grads.items()}
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip; in hybrid-parallel training the squared norms
+    are all-reduced across model-parallel groups before the scale
+    (reference: HybridParallelClipGrad,
+    fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:45).
+    The cross-rank reduction happens automatically under pjit because the
+    norm is computed on sharded arrays."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            sq.append(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor((gv.astype(jnp.float32) * scale
+                                   ).astype(gv.dtype),
+                                  stop_gradient=True, _internal=True)))
+        return out
+
+    def functional_clip(self, grads):
+        sq = [jnp.sum(jnp.square(v.astype(jnp.float32)))
+              for v in grads.values()]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                            1.0)
+        return {k: (v.astype(jnp.float32) * scale).astype(v.dtype)
+                for k, v in grads.items()}

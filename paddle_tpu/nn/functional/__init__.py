@@ -1,0 +1,232 @@
+"""paddle.nn.functional (reference: python/paddle/nn/functional/).
+
+Mostly re-exports the functional op library; adds the layer-flavored
+ops (linear, embedding, dropout, interpolate, attention helpers)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.engine import apply_op, in_trace_mode
+from ...core.tensor import Tensor
+from ...ops.activation import *  # noqa: F401,F403
+from ...ops.conv import *  # noqa: F401,F403
+from ...ops.loss_ops import *  # noqa: F401,F403
+from ...ops.norm_ops import *  # noqa: F401,F403
+from ...ops.manipulation import pad  # noqa: F401
+from ...ops.creation import one_hot  # noqa: F401
+from ...ops import random as _random
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; W is [in, out] (reference matmul weight layout,
+    python/paddle/nn/functional/common.py linear)."""
+
+    def _k(x, w, b):
+        y = x @ w
+        if b is not None:
+            y = y + b
+        return y
+
+    return apply_op("linear", _k, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _k(ids, w, padding_idx):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+    return apply_op("embedding", _k, x, weight,
+                    padding_idx=None if padding_idx is None else int(padding_idx))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x
+    key = _random.next_key()
+
+    def _k(v, key, p, axis, mode):
+        if axis is None:
+            shape = v.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(v.shape[i] if i in axes else 1
+                          for i in range(v.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op("dropout", _k, x, key=key, p=float(p), axis=axis,
+                    mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _random.next_key()
+
+    def _k(v, key, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op("alpha_dropout", _k, x, key=key, p=float(p))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    nd = x.ndim - 2
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._value).reshape(-1)]
+        out_size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                         for s in (size if isinstance(size, (list, tuple))
+                                   else [size]))
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        out_size = tuple(int(round(s * f))
+                         for s, f in zip(spatial, scale_factor))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "trilinear": "linear", "linear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+
+    def _k(v, out_size, method, channel_last):
+        if channel_last:
+            full = (v.shape[0],) + out_size + (v.shape[-1],)
+        else:
+            full = v.shape[:2] + out_size
+        return jax.image.resize(v, full, method=method).astype(v.dtype)
+
+    return apply_op("interpolate", _k, x, out_size=out_size, method=method,
+                    channel_last=channel_last)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from ...ops.manipulation import unfold as _unfold
+
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def _k(v, oh, ow, kh, kw, sh, sw, ph, pw, dh, dw):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        out_h = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        out_w = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        v = v.reshape(n, c, kh, kw, out_h, out_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + out_h * sh:sh,
+                             wj:wj + out_w * sw:sw].add(v[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op("fold", _k, x, oh=oh, ow=ow, kh=kh, kw=kw, sh=sh, sw=sw,
+                    ph=ph, pw=pw, dh=dh, dw=dw)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused attention entry — routes to the Pallas flash kernel when
+    available (see incubate/nn/attention.py), else the XLA path."""
+    from ...incubate.nn import attention as _attn
+
+    return _attn.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    def _k(v, m):
+        return jax.nn.softmax(v + m, axis=-1)
+
+    return apply_op("softmax_mask_fuse", _k, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def _k(v):
+        s = v.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, v, -1e9), axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", _k, x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import convert_dtype
+
+    if maxlen is None:
+        maxlen = int(np.asarray(x._value).max())
+
+    def _k(v, maxlen, dtype):
+        return (jnp.arange(maxlen)[None, :] < v[..., None]).astype(dtype)
+
+    return apply_op("sequence_mask", _k, x, maxlen=int(maxlen),
+                    dtype=convert_dtype(dtype))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: planned (PS feature)")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def _k(v, seg_num, shift_ratio):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        out = jnp.zeros_like(v)
+        # shift left
+        out = out.at[:, :-1, :fold_c].set(v[:, 1:, :fold_c])
+        # shift right
+        out = out.at[:, 1:, fold_c:2 * fold_c].set(v[:, :-1, fold_c:2 * fold_c])
+        out = out.at[:, :, 2 * fold_c:].set(v[:, :, 2 * fold_c:])
+        return out.reshape(nt, c, h, w)
+
+    return apply_op("temporal_shift", _k, x, seg_num=int(seg_num),
+                    shift_ratio=float(shift_ratio))

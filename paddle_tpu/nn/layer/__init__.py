@@ -1,0 +1,11 @@
+from .layers import Layer
+from .common import *  # noqa
+from .conv import *  # noqa
+from .norm import *  # noqa
+from .pooling import *  # noqa
+from .activation import *  # noqa
+from .container import *  # noqa
+from .loss import *  # noqa
+from .transformer import *  # noqa
+from .rnn import *  # noqa
+from .vision import *  # noqa

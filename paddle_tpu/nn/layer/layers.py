@@ -1,0 +1,359 @@
+"""Layer base class (reference: python/paddle/fluid/dygraph/layers.py,
+1,679 LoC — parameter/sublayer registries, hooks, state_dict,
+train/eval). TPU-native: parameters are jax-backed Tensors; `to()`
+re-places them via device_put; functional extraction for jit lives in
+paddle_tpu/jit (not here) and works off the same registries."""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import convert_dtype
+from ...core.tensor import Parameter, Tensor
+from ...core import engine
+from ..initializer import Constant, Initializer, XavierNormal, Uniform
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype else None
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute routing ------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params.pop(name)
+                object.__setattr__(self, name, None)
+            elif isinstance(value, Tensor):
+                params[name] = value if isinstance(value, Parameter) else \
+                    _as_param(value)
+            else:
+                params.pop(name)
+                object.__setattr__(self, name, value)
+        elif buffers is not None and name in buffers:
+            if isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers.pop(name)
+                object.__setattr__(self, name, value)
+        elif layers is not None and name in layers and value is None:
+            layers.pop(name)
+            object.__setattr__(self, name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        base = list(super().__dir__())
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store) or {}
+            base.extend(d.keys())
+        return sorted(set(base))
+
+    # -- parameter creation ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..param_attr import ParamAttr
+
+        dtype = convert_dtype(dtype) or self._dtype or jnp.float32
+        if default_initializer is None:
+            default_initializer = Constant(0.0) if is_bias else XavierNormal()
+        init = default_initializer
+        name = None
+        trainable = True
+        if isinstance(attr, ParamAttr):
+            if attr.initializer is not None:
+                init = attr.initializer
+            name = attr.name
+            trainable = attr.trainable
+        elif isinstance(attr, Initializer):
+            init = attr
+        elif attr is False and is_bias:
+            return None
+        elif isinstance(attr, str):
+            name = attr
+        p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dtype),
+                      trainable=trainable, name=name)
+        init(p)
+        if not engine.in_trace_mode():
+            from ...core.place import current_device
+
+            p._value = jax.device_put(p._value, current_device())
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        dtype = convert_dtype(dtype) or self._dtype or jnp.float32
+        t = Tensor(jnp.zeros((), dtype), _internal=True)
+        t.name = name or t.name
+        t.persistable = bool(persistable)
+        return t
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = _as_param(parameter)
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    # -- iteration --------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, lay in self.named_sublayers(prefix=prefix,
+                                              include_self=True):
+            if not include_sublayers and lay is not self:
+                continue
+            for pname, p in lay._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname, p)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, lay in self.named_sublayers(prefix=prefix,
+                                              include_self=True):
+            if not include_sublayers and lay is not self:
+                continue
+            for bname, b in lay._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname, b)
+
+    def children(self):
+        return (l for _, l in self.named_children())
+
+    def named_children(self):
+        seen = set()
+        for name, lay in self._sub_layers.items():
+            if lay is not None and id(lay) not in seen:
+                seen.add(id(lay))
+                yield name, lay
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, lay in self._sub_layers.items():
+            if lay is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from lay.named_sublayers(prefix=sub_prefix,
+                                           include_self=True,
+                                           layers_set=layers_set)
+
+    def apply(self, fn):
+        for lay in self.sublayers(include_self=True):
+            fn(lay)
+        return self
+
+    def full_name(self):
+        return self._name_scope
+
+    # -- train/eval -------------------------------------------------------
+    def train(self):
+        for lay in self.sublayers(include_self=True):
+            lay.training = True
+        return self
+
+    def eval(self):
+        for lay in self.sublayers(include_self=True):
+            lay.training = False
+        return self
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        out = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            shortname = name.rsplit(".", 1)[-1]
+            if shortname in self._non_persistable_buffer_names_set:
+                continue
+            out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        own = dict(self.named_parameters())
+        own.update(dict(self.named_buffers()))
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            tgt = own.pop(name)
+            v = value._value if isinstance(value, Tensor) else jnp.asarray(
+                np.asarray(value))
+            if tuple(v.shape) != tuple(tgt.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {v.shape} vs {tgt.shape}")
+            tgt._value = v.astype(tgt._value.dtype)
+        missing = list(own.keys())
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- conversion -------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        dt = convert_dtype(dtype) if dtype is not None else None
+        dev = None
+        if device is not None:
+            from ...core.place import device_of, Place
+            from ...core.tensor import _parse_place
+
+            place = device if isinstance(device, Place) else _parse_place(device)
+            dev = device_of(place)
+        for _, p in list(self.named_parameters()) + list(self.named_buffers()):
+            v = p._value
+            if dt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(dt)
+            if dev is not None:
+                v = jax.device_put(v, dev)
+            p._value = v
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- hooks ------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call -------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, lay in self._sub_layers.items():
+            sub = repr(lay).split("\n")
+            sub = [sub[0]] + ["  " + l for l in sub[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+def _as_param(t: Tensor) -> Parameter:
+    p = Parameter(t._value, trainable=not t.stop_gradient, name=t.name)
+    return p

@@ -1,0 +1,91 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+    "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+    "AdaptiveMaxPool3D",
+]
+
+
+class _Pool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kwargs = kwargs
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride, self.padding,
+                              **self.kwargs)
+
+    def extra_repr(self):
+        return (f"kernel_size={self.kernel_size}, stride={self.stride}, "
+                f"padding={self.padding}")
+
+
+class MaxPool1D(_Pool):
+    _fn = staticmethod(F.max_pool1d)
+
+
+class MaxPool2D(_Pool):
+    _fn = staticmethod(F.max_pool2d)
+
+
+class MaxPool3D(_Pool):
+    _fn = staticmethod(F.max_pool3d)
+
+
+class AvgPool1D(_Pool):
+    _fn = staticmethod(F.avg_pool1d)
+
+
+class AvgPool2D(_Pool):
+    _fn = staticmethod(F.avg_pool2d)
+
+
+class AvgPool3D(_Pool):
+    _fn = staticmethod(F.avg_pool3d)
+
+
+class _AdaptivePool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, **kwargs):
+        super().__init__()
+        self.output_size = output_size
+        self.kwargs = kwargs
+
+    def forward(self, x):
+        return type(self)._fn(x, self.output_size, **self.kwargs)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool1d)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool2d)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_avg_pool3d)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool1d)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool2d)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _fn = staticmethod(F.adaptive_max_pool3d)

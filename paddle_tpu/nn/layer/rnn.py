@@ -1,0 +1,388 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py — RNNCellBase,
+LSTM/GRU/SimpleRNN, cudnn rnn_op).
+
+TPU-native: the time loop is `lax.scan`, which XLA compiles into a
+single fused while-loop on device (the analog of cudnn's fused RNN
+kernels). Multi-layer + bidirectional stacks are unrolled in Python at
+trace time (static depth)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.engine import apply_op
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(
+                shape[0], (list, tuple)):
+            return tuple(full([b] + list(s), init_value,
+                              dtype or "float32") for s in shape)
+        return full([b] + list(shape), init_value, dtype or "float32")
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu}[name]
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _k(x, h, wi, wh, bi, bh, act):
+            out = _act(act)(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        h = apply_op("simple_rnn_cell", _k, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh,
+                     act=self.activation)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _k(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply_op("lstm_cell", _k, inputs, h, c,
+                                self.weight_ih, self.weight_hh, self.bias_ih,
+                                self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _k(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply_op("gru_cell", _k, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a time-looped layer via lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs = []
+        # eager scan in Python keeps tape autograd simple; under jit the
+        # whole loop gets traced & fused anyway. (lax.scan fast path is
+        # used by the functional `_rnn_scan` in jitted mode.)
+        seq_axis = 0 if self.time_major else 1
+        steps = inputs.shape[seq_axis]
+        state = initial_states
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ...ops.manipulation import stack
+
+        for t in rng:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, state = self.cell(xt, state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out_seq = stack(outs, axis=seq_axis)
+        return out_seq, state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stacked recurrent net,
+    computed with lax.scan over packed weights — one fused XLA while
+    loop per layer/direction."""
+
+    _mode = "RNN_TANH"
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self._mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = f"_reverse" if d == 1 else ""
+                wih = self.create_parameter(
+                    [gate_mult * hidden_size, in_sz], attr=weight_ih_attr,
+                    default_initializer=init)
+                whh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size],
+                    attr=weight_hh_attr, default_initializer=init)
+                bih = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_ih_attr,
+                    is_bias=True, default_initializer=init)
+                bhh = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_hh_attr,
+                    is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", whh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bhh)
+                self._all_weights.append((wih, whh, bih, bhh))
+
+    def _cell_step(self, mode, activation):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                f = jax.nn.sigmoid(f)
+                o = jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h2 = (1 - z) * c + z * h
+                return (h2,), h2
+        else:
+            act = _act(activation)
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                h2 = act(x @ wi.T + bi + h @ wh.T + bh)
+                return (h2,), h2
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self._mode
+        num_dirs = 2 if self.bidirect else 1
+        n_states = 2 if mode == "LSTM" else 1
+        step = self._cell_step(mode, self.activation)
+        tm = self.time_major
+
+        def _k(x, weights, init_states, mode_tag):
+            # x: [B, S, I] (or [S, B, I] if time_major)
+            xs = x if tm else jnp.swapaxes(x, 0, 1)  # [S, B, I]
+            b = xs.shape[1]
+            layer_in = xs
+            final_h, final_c = [], []
+            wi_iter = iter(weights)
+            for layer in range(self.num_layers):
+                dir_outs = []
+                for d in range(num_dirs):
+                    wi, wh, bi, bh = (next(wi_iter), next(wi_iter),
+                                      next(wi_iter), next(wi_iter))
+                    idx = layer * num_dirs + d
+                    if init_states is not None:
+                        h0 = init_states[0][idx]
+                        c0 = (init_states[1][idx] if n_states == 2 else None)
+                    else:
+                        h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+                        c0 = (jnp.zeros((b, self.hidden_size), x.dtype)
+                              if n_states == 2 else None)
+                    carry0 = (h0, c0) if n_states == 2 else (h0,)
+                    seq = layer_in[::-1] if d == 1 else layer_in
+
+                    def scan_fn(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(carry, xt, wi, wh, bi, bh)
+
+                    carry, ys = jax.lax.scan(scan_fn, carry0, seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    dir_outs.append(ys)
+                    final_h.append(carry[0])
+                    if n_states == 2:
+                        final_c.append(carry[1])
+                layer_in = (jnp.concatenate(dir_outs, axis=-1)
+                            if num_dirs == 2 else dir_outs[0])
+            out = layer_in if tm else jnp.swapaxes(layer_in, 0, 1)
+            h = jnp.stack(final_h, axis=0)
+            if n_states == 2:
+                return out, h, jnp.stack(final_c, axis=0)
+            return out, h
+
+        weights = [w for tup in self._all_weights for w in tup]
+        init = None
+        if initial_states is not None:
+            if mode == "LSTM":
+                init = (initial_states[0], initial_states[1])
+            else:
+                init = (initial_states, None)
+        if mode == "LSTM":
+            out, h, c = apply_op("lstm", _k, inputs, weights,
+                                 init, mode_tag=mode)
+            return out, (h, c)
+        out, h = apply_op("rnn", _k, inputs, weights, init, mode_tag=mode)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation,
+                         **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

@@ -1,0 +1,68 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._value = vec._value[offset:offset + n].reshape(tuple(p.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference: nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    import jax
+
+    v = w._value
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=False))
+    layer.add_parameter(name + "_g", _param(g))
+    layer.add_parameter(name + "_v", _param(v))
+    del layer._parameters[name]
+
+    def hook(lay, inputs):
+        vv = lay._parameters[name + "_v"]
+        gg = lay._parameters[name + "_g"]
+        from ...core.engine import apply_op
+
+        def _k(v_, g_, dim):
+            axes = tuple(i for i in range(v_.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(v_), axis=axes,
+                                    keepdims=True))
+            shape = [1] * v_.ndim
+            shape[dim] = -1
+            return v_ / norm * g_.reshape(shape)
+
+        w = apply_op("weight_norm", _k, vv, gg, dim=dim)
+        object.__setattr__(lay, name, w)
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    return layer
+
+
+def _param(v):
+    from ...core.tensor import Parameter
+
+    return Parameter(v)

@@ -1,0 +1,12 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py via paddle2onnx).
+Export path: jax → StableHLO is the TPU-native serialization; ONNX
+export requires the external paddle2onnx tool and is gated."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires paddle2onnx (unavailable offline). Use "
+        "paddle_tpu.jit.save (StableHLO/params) instead.")

@@ -1,0 +1,50 @@
+"""Functional op library — the PHI kernel layer.
+
+Parity target: paddle/phi/kernels/* + python/paddle/tensor/*.
+Every op here is (a) a pure-jax kernel function and (b) a public wrapper
+that routes through the dygraph engine (`core.engine.apply_op`), so the
+same kernel serves eager execution, tape autograd (via jax.vjp) and
+jit/to_static tracing (via jax.grad over whole programs).
+"""
+from . import creation
+from . import math
+from . import logic
+from . import manipulation
+from . import linalg
+from . import search
+from . import random
+from . import activation
+from . import conv
+from . import norm_ops
+from . import loss_ops
+
+_MODULES = [
+    creation,
+    math,
+    logic,
+    manipulation,
+    linalg,
+    search,
+    random,
+    activation,
+    conv,
+    norm_ops,
+    loss_ops,
+]
+
+
+def _collect_public():
+    out = {}
+    for mod in _MODULES:
+        names = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")
+        ]
+        for n in names:
+            fn = getattr(mod, n, None)
+            if callable(fn):
+                out.setdefault(n, fn)
+    return out
+
+
+PUBLIC_OPS = _collect_public()
+globals().update(PUBLIC_OPS)

@@ -1,0 +1,227 @@
+"""Activation ops (reference: python/paddle/nn/functional/activation.py,
+paddle/phi/kernels activation kernels). XLA fuses these into neighboring
+matmuls on TPU — no hand-written fused variants needed for the
+elementwise family."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+__all__ = []
+
+
+def _export(name, fn):
+    setattr(_this, name, fn)
+    __all__.append(name)
+
+
+_SIMPLE = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0, 6),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "softsign": jax.nn.soft_sign,
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "log_sigmoid": jax.nn.log_sigmoid,
+}
+
+
+def _make(name, jfn):
+    def op(x, name=None, _jfn=jfn, _n=name):
+        return apply_op(_n, _jfn, x)
+
+    op.__name__ = name
+    return op
+
+
+for _n, _f in _SIMPLE.items():
+    _export(_n, _make(_n, _f))
+
+
+def _k_softmax(x, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = apply_op("softmax", _k_softmax, x, axis=int(axis))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = apply_op("log_softmax",
+                   lambda v, axis: jax.nn.log_softmax(v, axis=axis),
+                   x, axis=int(axis))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu",
+                    lambda v, approximate: jax.nn.gelu(v, approximate=approximate),
+                    x, approximate=bool(approximate))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v, alpha: jax.nn.elu(v, alpha=alpha),
+                    x, alpha=float(alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        "selu",
+        lambda v, scale, alpha: scale * jnp.where(
+            v > 0, v, alpha * jnp.expm1(v)),
+        x, scale=float(scale), alpha=float(alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v, alpha: jax.nn.celu(v, alpha=alpha),
+                    x, alpha=float(alpha))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(
+        "leaky_relu",
+        lambda v, slope: jax.nn.leaky_relu(v, negative_slope=slope),
+        x, slope=float(negative_slope))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _k(v, w, channel_axis):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        shape[channel_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    ca = 1 if data_format == "NCHW" else -1
+    return apply_op("prelu", _k, x, weight, channel_axis=ca)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    if training:
+        from .random import next_key
+
+        key = next_key()
+
+        def _k(v, key, lower, upper):
+            a = jax.random.uniform(key, v.shape, dtype=v.dtype,
+                                   minval=lower, maxval=upper)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply_op("rrelu", _k, x, key=key, lower=lower, upper=upper)
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v, mn, mx: jnp.clip(v, mn, mx),
+                    x, mn=float(min), mx=float(max))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink",
+        lambda v, t: jnp.where(jnp.abs(v) > t, v, 0.0).astype(v.dtype),
+        x, t=float(threshold))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v, t: jnp.where(v > t, v - t, jnp.where(v < -t, v + t, 0.0)
+                               ).astype(v.dtype),
+        x, t=float(threshold))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(
+        "hardsigmoid",
+        lambda v, slope, offset: jnp.clip(slope * v + offset, 0.0, 1.0),
+        x, slope=float(slope), offset=float(offset))
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish",
+                    lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda v, beta, threshold: jnp.where(
+            beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        x, beta=float(beta), threshold=float(threshold))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        "thresholded_relu",
+        lambda v, t, value: jnp.where(v > t, v, value).astype(v.dtype),
+        x, t=float(threshold), value=float(value))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh",
+                    lambda v, a, b: b * jnp.tanh(a * v),
+                    x, a=float(scale_a), b=float(scale_b))
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda v, axis: jax.nn.glu(v, axis=axis),
+                    x, axis=int(axis))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _k(v, groups, axis):
+        shape = list(v.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(shape), axis=axis + 1)
+
+    return apply_op("maxout", _k, x, groups=int(groups), axis=int(axis))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._value = out._value
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from .random import next_key
+
+    key = next_key()
+
+    def _k(v, key, temperature, hard, axis):
+        g = jax.random.gumbel(key, v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(onehot - y) + y  # straight-through
+        return y
+
+    return apply_op("gumbel_softmax", _k, x, key=key,
+                    temperature=float(temperature), hard=bool(hard),
+                    axis=int(axis))
+
+
+for _n in ["softmax", "log_softmax", "gelu", "elu", "selu", "celu",
+           "leaky_relu", "prelu", "rrelu", "hardtanh", "hardshrink",
+           "softshrink", "hardsigmoid", "hardswish", "softplus",
+           "thresholded_relu", "stanh", "glu", "maxout", "softmax_",
+           "gumbel_softmax"]:
+    __all__.append(_n)

@@ -1,0 +1,415 @@
+"""Convolution + pooling kernels.
+
+Parity target: paddle/fluid/operators/conv_op.* (cudnn path),
+pool_op.*, python/paddle/nn/functional/conv.py, pooling.py.
+
+TPU-native design: convs lower to `lax.conv_general_dilated`, which XLA
+maps onto the MXU as implicit GEMM; depthwise uses feature_group_count.
+Data layout stays in the user's NCHW/NHWC — XLA picks the internal
+layout for the TPU, so no manual layout transposes (the reference's
+cudnn layout logic has no analog here).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "max_pool1d", "max_pool2d", "max_pool3d",
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d", "grid_sample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n, stride=None):
+    """Convert paddle padding spec to lax spec."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # possibly includes batch/channel dims — take the last n entries
+        pads = [tuple(int(x) for x in p) for p in padding]
+        return pads[-n:]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(ndim_spatial, channel_last):
+    if ndim_spatial == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim_spatial == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _k_conv(x, w, bias, stride, padding, dilation, groups, dn):
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        if dn[2].endswith("C"):
+            out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * (out.ndim - 2))
+    return out
+
+
+def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
+             data_format, opname):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = _dim_numbers(n, channel_last)
+    # paddle weights are always [out_c, in_c/groups, *spatial] (OIHW)
+    if channel_last:
+        # lax expects HWIO for NHWC; convert OIHW -> HWIO
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        weight = apply_op("transpose_w",
+                          lambda w, perm: jnp.transpose(w, perm),
+                          weight, perm=perm)
+    return apply_op(
+        opname, _k_conv, x, weight, bias,
+        stride=_tup(stride, n), padding=_padding(padding, n),
+        dilation=_tup(dilation, n), groups=int(groups), dn=dn)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups,
+                    df, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, "conv3d")
+
+
+def _k_conv_transpose(x, w, bias, stride, padding, dilation, groups, dn,
+                      output_padding):
+    # gradient-of-conv formulation: lhs_dilation implements the stride
+    n = len(stride)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(dilation[i] * (w.shape[2 + i] - 1) - padding[i][0],
+                dilation[i] * (w.shape[2 + i] - 1) - padding[i][1]
+                + output_padding[i])
+               for i in range(n)]
+    # OIHW -> IOHW flipped
+    wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    wt = jnp.swapaxes(wt, 0, 1)
+    if groups > 1:
+        # [I, O/g? ...] handle grouped transpose: reshape trick
+        ci, co = w.shape[0], w.shape[1] * groups
+        wt = w.reshape((groups, w.shape[0] // groups) + w.shape[1:])
+        wt = jnp.flip(wt, axis=tuple(range(3, 3 + n)))
+        wt = jnp.swapaxes(wt, 1, 2)  # [g, o_per, i_per, ...]
+        wt = wt.reshape((w.shape[1] * groups, w.shape[0] // groups) + w.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * n, padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        if dn[2].endswith("C"):
+            out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * (out.ndim - 2))
+    return out
+
+
+def _conv_transpose_nd(n, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, opname):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = _dim_numbers(n, channel_last)
+    pad = _padding(padding, n)
+    return apply_op(
+        opname, _k_conv_transpose, x, weight, bias,
+        stride=_tup(stride, n), padding=pad, dilation=_tup(dilation, n),
+        groups=int(groups), dn=("NCHW", "OIHW", "NCHW") if n == 2 and not channel_last else dn,
+        output_padding=_tup(output_padding, n))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, df,
+                              "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              "conv3d_transpose")
+
+
+# -- pooling ------------------------------------------------------------
+
+
+def _pool(x, n, kernel, stride, padding, kind, channel_last, ceil_mode=False,
+          exclusive=True, opname="pool"):
+    kernel = _tup(kernel, n)
+    stride = _tup(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+
+    def _k(v, kernel, stride, pad, kind, channel_last, exclusive):
+        nd = v.ndim
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            if channel_last:
+                padding_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+            else:
+                padding_cfg = [(0, 0), (0, 0)] + list(pad)
+        # init values MUST stay concrete (numpy) so JAX recognizes the
+        # monoid reducer and uses the differentiable reduce_window_max/
+        # add primitives — a traced init breaks autodiff under jit(grad).
+        if kind == "max":
+            init = (np.asarray(-np.inf, v.dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                    else np.asarray(np.iinfo(v.dtype).min, v.dtype))
+            return jax.lax.reduce_window(v, init, jax.lax.max, dims, strides,
+                                         padding_cfg)
+        # avg
+        zero = np.asarray(0, v.dtype)
+        s = jax.lax.reduce_window(v, zero, jax.lax.add, dims, strides,
+                                  padding_cfg)
+        if exclusive:
+            cnt = jax.lax.reduce_window(jnp.ones_like(v), zero, jax.lax.add,
+                                        dims, strides, padding_cfg)
+            return s / cnt
+        return s / np.prod(kernel)
+
+    return apply_op(opname, _k, x, kernel=kernel, stride=stride, pad=pad,
+                    kind=kind, channel_last=channel_last,
+                    exclusive=bool(exclusive))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "max",
+                 data_format == "NLC", ceil_mode, opname="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "max",
+                 data_format == "NHWC", ceil_mode, opname="max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "max",
+                 data_format == "NDHWC", ceil_mode, opname="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "avg",
+                 data_format == "NLC", ceil_mode, exclusive, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "avg",
+                 data_format == "NHWC", ceil_mode, exclusive, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "avg",
+                 data_format == "NDHWC", ceil_mode, exclusive, "avg_pool3d")
+
+
+def _adaptive_pool(x, n, output_size, kind, channel_last, opname):
+    out_size = _tup(output_size, n)
+
+    def _k(v, out_size, kind, channel_last):
+        sp_start = 1 if channel_last else 2
+        out = v
+        for i, osz in enumerate(out_size):
+            ax = sp_start + i
+            isz = v.shape[ax]
+            if osz is None:
+                continue
+            # split axis into osz windows (requires isz % osz == 0 for the
+            # fast path; general case uses mean over index ranges)
+            if isz % osz == 0:
+                k = isz // osz
+                shape = list(out.shape)
+                shape[ax:ax + 1] = [osz, k]
+                r = out.reshape(shape)
+                out = (jnp.max(r, axis=ax + 1) if kind == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                slices = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = (jnp.max(seg, axis=ax, keepdims=True) if kind == "max"
+                           else jnp.mean(seg, axis=ax, keepdims=True))
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return apply_op(opname, _k, x, out_size=out_size, kind=kind,
+                    channel_last=channel_last)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, 1, output_size, "avg", False, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, 2, output_size, "avg", data_format == "NHWC",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, 3, output_size, "avg", data_format == "NDHWC",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 1, output_size, "max", False, "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 2, output_size, "max", False, "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, 3, output_size, "max", False, "adaptive_max_pool3d")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def _k(v, r, channel_last):
+        if channel_last:
+            n, h, w, c = v.shape
+            v = v.reshape(n, h, w, c // (r * r), r, r)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, h * r, w * r, c // (r * r))
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op("pixel_shuffle", _k, x, r=int(upscale_factor),
+                    channel_last=data_format == "NHWC")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def _k(v, r, channel_last):
+        if channel_last:
+            n, h, w, c = v.shape
+            v = v.reshape(n, h // r, r, w // r, r, c)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, h // r, w // r, c * r * r)
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op("pixel_unshuffle", _k, x, r=int(downscale_factor),
+                    channel_last=data_format == "NHWC")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _k(v, g, channel_last):
+        if channel_last:
+            n, h, w, c = v.shape
+            v = v.reshape(n, h, w, g, c // g)
+            return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+        n, c, h, w = v.shape
+        v = v.reshape(n, g, c // g, h, w)
+        return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply_op("channel_shuffle", _k, x, g=int(groups),
+                    channel_last=data_format == "NHWC")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def _k(v, g, align_corners):
+        # v: [N, C, H, W]; g: [N, Hg, Wg, 2] in [-1, 1]
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = ix - x0
+        wy = iy - y0
+
+        def sample(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+            batch_idx = jnp.arange(n).reshape(n, 1, 1)
+            out = v[batch_idx, :, yi, xi]  # [N, Hg, Wg, C]
+            return jnp.where(valid[..., None], out, 0.0)
+
+        out = (sample(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+               + sample(y0, x1) * (wx * (1 - wy))[..., None]
+               + sample(y1, x0) * ((1 - wx) * wy)[..., None]
+               + sample(y1, x1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_op("grid_sample", _k, x, grid,
+                    align_corners=bool(align_corners))

@@ -1,0 +1,232 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py,
+paddle/phi/kernels/full_kernel.h etc.)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.engine import apply_op, in_trace_mode
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "to_tensor", "clone",
+    "complex", "real", "imag", "as_real", "as_complex", "tril_indices",
+    "triu_indices", "one_hot",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _mk(value_fn):
+    """Wrap a constant-producing jnp call into a Tensor on current place."""
+    val = value_fn()
+    t = Tensor(val, _internal=True)
+    if not in_trace_mode():
+        from ..core.place import current_device
+
+        t._value = jax.device_put(val, current_device())
+    return t
+
+
+def zeros(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _mk(lambda: jnp.zeros(_shape_list(shape), dt))
+
+
+def ones(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _mk(lambda: jnp.ones(_shape_list(shape), dt))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    if dtype is None:
+        dt = jnp.result_type(fill_value)
+        if dt == jnp.float64:
+            dt = default_float_dtype()
+    else:
+        dt = convert_dtype(dtype)
+    return _mk(lambda: jnp.full(_shape_list(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def _k_zeros_like(x, dtype):
+    return jnp.zeros(x.shape, dtype or x.dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op("zeros_like", _k_zeros_like, x, dtype=convert_dtype(dtype))
+
+
+def _k_ones_like(x, dtype):
+    return jnp.ones(x.shape, dtype or x.dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op("ones_like", _k_ones_like, x, dtype=convert_dtype(dtype))
+
+
+def _k_full_like(x, fill_value, dtype):
+    return jnp.full(x.shape, fill_value, dtype or x.dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = float(fill_value._value)
+    return apply_op("full_like", _k_full_like, x, fill_value=fill_value,
+                    dtype=convert_dtype(dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    dt = convert_dtype(dtype)
+    if dt is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dt = default_float_dtype()
+        else:
+            dt = jnp.int64
+    return _mk(lambda: jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _mk(lambda: jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _mk(lambda: jnp.logspace(start, stop, int(num), base=base, dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _mk(lambda: jnp.eye(int(num_rows),
+                               int(num_columns) if num_columns else None,
+                               dtype=dt))
+
+
+def _k_diag(x, offset, padding_value):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x), k=offset)
+            out = out + (1 - mask) * jnp.asarray(padding_value, out.dtype)
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply_op("diag", _k_diag, x, offset=int(offset),
+                    padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda v, offset: jnp.diagflat(v, k=offset),
+                    x, offset=int(offset))
+
+
+def _k_tril(x, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", _k_tril, x, diagonal=int(diagonal))
+
+
+def _k_triu(x, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", _k_triu, x, diagonal=int(diagonal))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_op("meshgrid",
+                    lambda xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    list(args))
+
+
+def assign(x, output=None):
+    if isinstance(x, Tensor):
+        out = apply_op("assign", lambda v: v + 0, x)
+    else:
+        out = to_tensor(np.asarray(x))
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply_op("clone", lambda v: v + 0, x)
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", jax.lax.complex, real, imag)
+
+
+def real(x, name=None):
+    return apply_op("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply_op("imag", jnp.imag, x)
+
+
+def as_real(x, name=None):
+    return apply_op("as_real",
+                    lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    idx = np.tril_indices(row, offset, col)
+    return to_tensor(np.stack(idx).astype(np.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    idx = np.triu_indices(row, offset, col)
+    return to_tensor(np.stack(idx).astype(np.int64))
+
+
+def _k_one_hot(x, num_classes, dtype):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot", _k_one_hot, x, num_classes=int(num_classes),
+                    dtype=default_float_dtype())

@@ -1,0 +1,342 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py,
+paddle/phi/kernels/matmul_kernel.h, paddle/fluid/operators/math/blas*).
+
+matmul is THE MXU op: kernels keep operands batched and let XLA tile
+onto the 128x128 systolic array; bf16 inputs hit native MXU throughput.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "mv", "dot", "norm", "dist", "cross", "cholesky",
+    "cholesky_solve", "inv", "det", "slogdet", "svd", "qr", "eigh", "eig",
+    "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
+    "matrix_power", "matrix_rank", "pinv", "multi_dot", "cond",
+    "corrcoef", "cov", "bincount", "histogram", "einsum", "lu", "lu_unpack",
+    "tensordot", "matrix_norm", "vector_norm", "householder_product",
+    "inverse",
+]
+
+
+def _k_matmul(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply_op("matmul", _k_matmul, x, y,
+                    transpose_x=bool(transpose_x),
+                    transpose_y=bool(transpose_y))
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", lambda a, v: a @ v, x, vec)
+
+
+def _k_dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", _k_dot, x, y)
+
+
+def _k_norm(x, p, axis, keepdim):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim),
+        1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+        if p == "fro" or p == 2:
+            return apply_op("norm", _k_norm, x, p="fro", axis=axis,
+                            keepdim=bool(keepdim))
+    elif axis is not None:
+        axis = int(axis)
+    return apply_op("norm", _k_norm, x, p=p, axis=axis, keepdim=bool(keepdim))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def _k(v, p, axis, keepdim):
+        return jnp.linalg.norm(v, ord=p, axis=axis, keepdims=keepdim)
+
+    return apply_op("matrix_norm", _k, x, p=p, axis=tuple(axis),
+                    keepdim=bool(keepdim))
+
+
+def dist(x, y, p=2, name=None):
+    def _k(a, b, p):
+        return _k_norm(a - b, p if p != 2 else "fro", None, False)
+
+    return apply_op("dist", _k, x, y, p=float(p) if p not in ("fro", "nuc") else p)
+
+
+def cross(x, y, axis=9, name=None):
+    def _k(a, b, axis):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op("cross", _k, x, y, axis=int(axis) if axis is not None else 9)
+
+
+def _simple(name, jfn):
+    def op(x, name=None):
+        return apply_op(name, jfn, x)
+
+    op.__name__ = name
+    return op
+
+
+cholesky_kernel = lambda v, upper: (jnp.linalg.cholesky(v) if not upper
+                                    else jnp.swapaxes(jnp.linalg.cholesky(
+                                        jnp.swapaxes(v, -1, -2).conj()), -1, -2).conj())
+
+
+def cholesky(x, upper=False, name=None):
+    return apply_op("cholesky", cholesky_kernel, x, upper=bool(upper))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _k(b, chol, upper):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return apply_op("cholesky_solve", _k, x, y, upper=bool(upper))
+
+
+def inv(x, name=None):
+    return apply_op("inv", jnp.linalg.inv, x)
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    out = apply_op("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), x)
+    from .manipulation import stack
+
+    return stack(list(out), axis=0)
+
+
+def svd(x, full_matrices=False, name=None):
+    out = apply_op("svd",
+                   lambda v, fm: tuple(jnp.linalg.svd(v, full_matrices=fm)),
+                   x, fm=bool(full_matrices))
+    return tuple(out)
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply_op("qr", lambda v, mode: tuple(jnp.linalg.qr(v, mode=mode)),
+                   x, mode=mode)
+    return tuple(out) if mode != "r" else out
+
+
+def eigh(x, UPLO="L", name=None):
+    out = apply_op("eigh",
+                   lambda v, uplo: tuple(jnp.linalg.eigh(v, symmetrize_input=True)),
+                   x, uplo=UPLO)
+    return tuple(out)
+
+
+def eig(x, name=None):
+    # general eig is CPU-only in jax; run on host
+    w, v = np.linalg.eig(np.asarray(x._value))
+    from .creation import to_tensor
+
+    return to_tensor(w), to_tensor(v)
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(x._value))
+    from .creation import to_tensor
+
+    return to_tensor(w)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v), x)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _k(a, b, upper, transpose, unit):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unit)
+
+    return apply_op("triangular_solve", _k, x, y, upper=bool(upper),
+                    transpose=bool(transpose), unit=bool(unitriangular))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    out = apply_op(
+        "lstsq",
+        lambda a, b, rcond: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+        x, y, rcond=rcond)
+    return tuple(out)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power",
+                    lambda v, n: jnp.linalg.matrix_power(v, n), x, n=int(n))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        "matrix_rank",
+        lambda v, tol: jnp.linalg.matrix_rank(v, rtol=tol),
+        x, tol=tol)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv",
+                    lambda v, rcond: jnp.linalg.pinv(v, rtol=rcond),
+                    x, rcond=float(rcond))
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda xs: jnp.linalg.multi_dot(xs), list(x))
+
+
+def cond(x, p=None, name=None):
+    return apply_op("cond", lambda v, p: jnp.linalg.cond(v, p=p), x, p=p)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def _k(v, rowvar, ddof):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+
+    return apply_op("cov", _k, x, rowvar=bool(rowvar), ddof=bool(ddof))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef",
+                    lambda v, rowvar: jnp.corrcoef(v, rowvar=rowvar),
+                    x, rowvar=bool(rowvar))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._value)
+    length = max(int(minlength), int(arr.max()) + 1 if arr.size else 0)
+
+    def _k(v, w, length):
+        return jnp.bincount(v, weights=w, length=length)
+
+    if weights is not None:
+        return apply_op("bincount", _k, x, weights, length=length)
+    return apply_op("bincount", lambda v, length: jnp.bincount(v, length=length),
+                    x, length=length)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _k(v, bins, lo, hi):
+        if lo == 0 and hi == 0:
+            lo, hi = v.min(), v.max()
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+
+    return apply_op("histogram", _k, input, bins=int(bins), lo=min, hi=max)
+
+
+def einsum(equation, *operands):
+    ops = list(operands[0]) if len(operands) == 1 and isinstance(
+        operands[0], (list, tuple)) else list(operands)
+    return apply_op("einsum",
+                    lambda xs, eq: jnp.einsum(eq, *xs), ops, eq=equation)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return apply_op("tensordot",
+                    lambda a, b, axes: jnp.tensordot(a, b, axes=axes),
+                    x, y, axes=axes)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    out = apply_op("lu", lambda v: tuple(jax.scipy.linalg.lu_factor(v)), x)
+    lu_mat, piv = out
+    from .creation import zeros
+
+    infos = zeros([x.shape[0]] if x.ndim > 2 else [], dtype="int32")
+    if get_infos:
+        return lu_mat, piv, infos
+    return lu_mat, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    def _k(lu_mat, piv):
+        m = lu_mat.shape[-2]
+        l = jnp.tril(lu_mat, -1) + jnp.eye(m, lu_mat.shape[-1], dtype=lu_mat.dtype)
+        u = jnp.triu(lu_mat)
+        # build permutation matrix from pivots
+        perm = jnp.arange(m)
+        def body(i, p):
+            j = piv[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        pmat = jnp.eye(m, dtype=lu_mat.dtype)[perm]
+        return pmat.T, l, u
+
+    out = apply_op("lu_unpack", _k, lu_data, lu_pivots)
+    return tuple(out)
+
+
+def householder_product(x, tau, name=None):
+    def _k(v, t):
+        m, n = v.shape[-2], v.shape[-1]
+        q = jnp.eye(m, dtype=v.dtype)
+        for i in range(n):
+            w = v[..., :, i]
+            w = jnp.where(jnp.arange(m) < i, 0.0, w).at[i].set(1.0)
+            q = q - t[i] * (q @ jnp.outer(w, w))
+        return q[..., :, :n]
+
+    return apply_op("householder_product", _k, x, tau)

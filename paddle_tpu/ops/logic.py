@@ -1,0 +1,98 @@
+"""Comparison / logical / bitwise ops (reference:
+python/paddle/tensor/logic.py, paddle/fluid/operators/controlflow/
+compare_op.cc, logical_op.cc)."""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+__all__ = []
+
+
+def _export(name, fn):
+    setattr(_this, name, fn)
+    __all__.append(name)
+
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift,
+    "bitwise_right_shift": jnp.right_shift,
+}
+
+
+def _make(name, jfn):
+    def op(x, y, out=None, name=None, _jfn=jfn, _n=name):
+        return apply_op(_n, _jfn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+for _n, _f in _CMP.items():
+    _export(_n, _make(_n, _f))
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op("logical_not", jnp.logical_not, x)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op("bitwise_not", jnp.bitwise_not, x)
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all",
+                    lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "allclose",
+        lambda a, b, rtol, atol, equal_nan: jnp.allclose(
+            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "isclose",
+        lambda a, b, rtol, atol, equal_nan: jnp.isclose(
+            a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+def is_empty(x, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(np.bool_(int(np.prod(x.shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+_export("logical_not", logical_not)
+_export("bitwise_not", bitwise_not)
+_export("equal_all", equal_all)
+_export("allclose", allclose)
+_export("isclose", isclose)
+_export("is_empty", is_empty)
+_export("is_tensor", is_tensor)

@@ -1,0 +1,429 @@
+"""Loss kernels (reference: python/paddle/nn/functional/loss.py,
+paddle/fluid/operators/softmax_with_cross_entropy_op.*, bce_loss_op,
+smooth_l1, kldiv...). Softmax+CE is fused in one kernel (log-softmax +
+gather) exactly like the reference's fused op — XLA keeps it in one
+fusion on TPU."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "softmax_with_cross_entropy", "cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_similarity", "cosine_embedding_loss",
+    "label_smooth", "square_error_cost", "log_loss", "sigmoid_focal_loss",
+    "dice_loss", "npair_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def _k_softmax_ce(logits, label, soft_label, axis, ignore_index, reduction,
+                  use_weight):
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * lsm, axis=axis)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        picked = jnp.take_along_axis(
+            lsm, jnp.expand_dims(jnp.clip(lbl, 0, logits.shape[axis] - 1),
+                                 axis).astype(jnp.int32), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        # ignore_index can be negative (e.g. -1, or the -100 default) —
+        # always mask; labels equal to it must not count as class 0.
+        mask = (lbl != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = apply_op("softmax_with_cross_entropy", _k_softmax_ce, logits, label,
+                    soft_label=bool(soft_label), axis=int(axis),
+                    ignore_index=int(ignore_index), reduction="none",
+                    use_weight=False)
+    from .manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    if label_smoothing and label_smoothing > 0.0:
+        n = input.shape[axis]
+        if not soft_label:
+            label = apply_op(
+                "one_hot_smooth",
+                lambda l, n, axis, eps: jax.nn.one_hot(
+                    l.squeeze(axis) if l.ndim == input.ndim else l, n,
+                    axis=axis) * (1 - eps) + eps / n,
+                label, n=n, axis=int(axis), eps=float(label_smoothing))
+            soft_label = True
+
+    if not use_softmax:
+        # input already probabilities → NLL over log(prob)
+        def _k(p, l, w, axis, soft_label, reduction, ignore_index):
+            logp = jnp.log(jnp.maximum(p, 1e-30))
+            if soft_label:
+                loss = -jnp.sum(l * logp, axis=axis)
+                return _reduce(loss, reduction)
+            ll = l
+            if ll.ndim == p.ndim:
+                ll = jnp.squeeze(ll, axis=axis)
+            lidx = jnp.clip(ll, 0, p.shape[axis] - 1).astype(jnp.int32)
+            loss = -jnp.squeeze(jnp.take_along_axis(
+                logp, jnp.expand_dims(lidx, axis), axis=axis), axis=axis)
+            wsel = (w[lidx] if w is not None
+                    else jnp.ones_like(loss))
+            mask = (ll != ignore_index)
+            loss = jnp.where(mask, loss * wsel, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+
+        return apply_op("ce_prob", _k, input, label, weight, axis=int(axis),
+                        soft_label=bool(soft_label), reduction=reduction,
+                        ignore_index=int(ignore_index))
+
+    if weight is not None:
+        def _kw(logits, l, w, axis, reduction, ignore_index):
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+            ll = l
+            if ll.ndim == logits.ndim:
+                ll = jnp.squeeze(ll, axis=axis)
+            picked = -jnp.squeeze(jnp.take_along_axis(
+                lsm, jnp.expand_dims(jnp.clip(ll, 0, lsm.shape[axis] - 1
+                                              ).astype(jnp.int32), axis),
+                axis=axis), axis=axis)
+            wsel = w[jnp.clip(ll, 0, w.shape[0] - 1).astype(jnp.int32)]
+            mask = (ll != ignore_index)
+            picked = jnp.where(mask, picked * wsel, 0.0)
+            if reduction == "mean":
+                return jnp.sum(picked) / jnp.maximum(
+                    jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+            return _reduce(picked, reduction)
+
+        return apply_op("ce_weighted", _kw, input, label, weight,
+                        axis=int(axis), reduction=reduction,
+                        ignore_index=int(ignore_index))
+
+    return apply_op("cross_entropy", _k_softmax_ce, input, label,
+                    soft_label=bool(soft_label), axis=int(axis),
+                    ignore_index=int(ignore_index), reduction=reduction,
+                    use_weight=False)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def _k(logp, l, w, reduction, ignore_index):
+        lidx = jnp.clip(l, 0, logp.shape[1] - 1).astype(jnp.int32)
+        picked = -jnp.take_along_axis(
+            logp, jnp.expand_dims(lidx, 1), axis=1)[:, 0]
+        wsel = w[lidx] if w is not None else jnp.ones_like(picked)
+        mask = (l != ignore_index)
+        picked = jnp.where(mask, picked * wsel, 0.0)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.maximum(
+                jnp.sum(jnp.where(mask, wsel, 0.0)), 1e-12)
+        return _reduce(picked, reduction)
+
+    return apply_op("nll_loss", _k, input, label, weight,
+                    reduction=reduction, ignore_index=int(ignore_index))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss",
+                    lambda x, y, reduction: _reduce(jnp.square(x - y), reduction),
+                    input, label, reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost",
+                    lambda x, y: jnp.square(x - y), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss",
+                    lambda x, y, reduction: _reduce(jnp.abs(x - y), reduction),
+                    input, label, reduction=reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _k(x, y, delta, reduction):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta (huber): loss = huber w/ delta
+        return _reduce(loss * delta, reduction)
+
+    return apply_op("smooth_l1_loss", _k, input, label, delta=float(delta),
+                    reduction=reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def _k(p, y, w, reduction):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op("bce", _k, input, label, weight, reduction=reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def _k(z, y, w, pw, reduction):
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op("bce_logits", _k, logit, label, weight, pos_weight,
+                    reduction=reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def _k(logp, y, reduction, log_target):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op("kl_div", _k, input, label, reduction=reduction,
+                    log_target=bool(log_target))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def _k(x1, x2, y, margin, reduction):
+        loss = jnp.maximum(0.0, -y * (x1 - x2) + margin)
+        return _reduce(loss, reduction)
+
+    return apply_op("margin_ranking_loss", _k, input, other, label,
+                    margin=float(margin), reduction=reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def _k(x, y, margin, reduction):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return apply_op("hinge_embedding_loss", _k, input, label,
+                    margin=float(margin), reduction=reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _k(a, b, axis, eps):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op("cosine_similarity", _k, x1, x2, axis=int(axis),
+                    eps=float(eps))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def _k(a, b, y, margin, reduction):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op("cosine_embedding_loss", _k, input1, input2, label,
+                    margin=float(margin), reduction=reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _k(l, pd, eps):
+        n = l.shape[-1]
+        if pd is not None:
+            return (1 - eps) * l + eps * pd
+        return (1 - eps) * l + eps / n
+
+    return apply_op("label_smooth", _k, label, prior_dist,
+                    eps=float(epsilon))
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def _k(p, y, eps):
+        return -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+
+    return apply_op("log_loss", _k, input, label, eps=float(epsilon))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _k(z, y, norm, alpha, gamma, reduction):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+
+    return apply_op("sigmoid_focal_loss", _k, logit, label, normalizer,
+                    alpha=float(alpha), gamma=float(gamma),
+                    reduction=reduction)
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    def _k(p, y, eps):
+        y1 = jax.nn.one_hot(y[..., 0] if y.ndim == p.ndim else y,
+                            p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + eps) / (union + eps))
+
+    return apply_op("dice_loss", _k, input, label, eps=float(epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _k(a, p, l, l2_reg):
+        sim = a @ p.T
+        lbl = l.reshape(-1)
+        eq = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+        eq = eq / jnp.sum(eq, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(-eq * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+
+    return apply_op("npair_loss", _k, anchor, positive, labels,
+                    l2_reg=float(l2_reg))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    def _k(a, pos, neg, margin, p, eps, swap, reduction):
+        d_pos = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + eps, p),
+                                  axis=-1), 1 / p)
+        d_neg = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + eps, p),
+                                  axis=-1), 1 / p)
+        if swap:
+            d_pn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + eps, p),
+                                     axis=-1), 1 / p)
+            d_neg = jnp.minimum(d_neg, d_pn)
+        loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op("triplet_margin_loss", _k, input, positive, negative,
+                    margin=float(margin), p=float(p), eps=float(epsilon),
+                    swap=bool(swap), reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from .math import minimum
+
+        d_neg = minimum(d_neg, d_pn)
+    from .math import maximum
+    from . import math as _m
+
+    diff = d_pos - d_neg
+    loss = maximum(diff + margin, 0.0)
+    if reduction == "mean":
+        return _m.mean(loss)
+    if reduction == "sum":
+        return _m.sum(loss)
+    return loss
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def _k(x, y, reduction):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply_op("soft_margin_loss", _k, input, label, reduction=reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def _k(x, y, w, reduction):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, axis=-1)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply_op("multi_label_soft_margin_loss", _k, input, label, weight,
+                    reduction=reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def _k(x, y, log_input, full, eps, reduction):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + eps)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op("poisson_nll_loss", _k, input, label,
+                    log_input=bool(log_input), full=bool(full),
+                    eps=float(epsilon), reduction=reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def _k(mu, y, var, full, eps, reduction):
+        var = jnp.maximum(var, eps)
+        loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+
+    return apply_op("gaussian_nll_loss", _k, input, label, variance,
+                    full=bool(full), eps=float(epsilon), reduction=reduction)

@@ -1,0 +1,721 @@
+"""Shape / layout / indexing ops (reference:
+python/paddle/tensor/manipulation.py, phi kernels reshape/concat/split/
+gather/scatter/transpose/pad...). All static attributes are closed over
+as kwargs so XLA sees static shapes — the TPU-friendly contract."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "cast", "reshape", "reshape_", "transpose", "t", "flatten", "squeeze",
+    "unsqueeze", "concat", "stack", "split", "chunk", "tile", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "where", "slice", "strided_slice", "pad", "unstack",
+    "unbind", "repeat_interleave", "take_along_axis", "put_along_axis",
+    "getitem", "moveaxis", "swapaxes", "unfold", "as_strided", "view",
+    "view_as", "tensor_split", "hsplit", "vsplit", "dsplit", "atleast_1d",
+    "atleast_2d", "atleast_3d", "crop", "tolist", "flatten_", "squeeze_",
+    "unsqueeze_", "fill_diagonal_", "diag_embed", "shard_index",
+]
+
+
+def _k_cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return apply_op("cast", _k_cast, x, dtype=convert_dtype(dtype))
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _k_reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return apply_op("reshape", _k_reshape, x, shape=_shape_arg(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    x._node = out._node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_op("view_dtype", lambda v, dt: v.view(dt), x,
+                    dt=convert_dtype(shape_or_dtype))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def _k_transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return apply_op("transpose", _k_transpose, x, perm=perm)
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return apply_op("t", lambda v: v, x)
+    return apply_op("t", lambda v: jnp.swapaxes(v, -2, -1) if v.ndim == 2
+                    else v.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis",
+                    lambda v, src, dst: jnp.moveaxis(v, src, dst),
+                    x, src=source, dst=destination)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes",
+                    lambda v, a, b: jnp.swapaxes(v, a, b),
+                    x, a=int(axis0), b=int(axis1))
+
+
+def _k_flatten(x, start, stop):
+    shape = x.shape
+    n = len(shape)
+    start_ = start % n if n else 0
+    stop_ = stop % n if n else 0
+    new_shape = shape[:start_] + (-1,) + shape[stop_ + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    if x.ndim == 0:
+        return reshape(x, [1])
+    return apply_op("flatten", _k_flatten, x, start=int(start_axis),
+                    stop=int(stop_axis))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def _norm_axes(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = [int(v) for v in np.asarray(axis._value).reshape(-1)]
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return tuple(sorted(a % ndim if a < 0 else a for a in axis))
+
+
+def _k_squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return apply_op("squeeze", _k_squeeze, x, axis=_norm_axes(axis, x.ndim))
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def _k_unsqueeze(x, axis):
+    out = x
+    nd = x.ndim + len(axis)
+    for a in sorted(a % nd if a < 0 else a for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = [int(v) for v in np.asarray(axis._value).reshape(-1)]
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return apply_op("unsqueeze", _k_unsqueeze, x, axis=tuple(int(a) for a in axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def _k_concat(xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("concat", _k_concat, list(x), axis=int(axis))
+
+
+def _k_stack(xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op("stack", _k_stack, list(x), axis=int(axis))
+
+
+def _split_sections(x_dim, num_or_sections):
+    if isinstance(num_or_sections, int):
+        return num_or_sections
+    sections = [int(s._value) if isinstance(s, Tensor) else int(s)
+                for s in num_or_sections]
+    if -1 in sections:
+        rest = x_dim - sum(s for s in sections if s != -1)
+        sections = [rest if s == -1 else s for s in sections]
+    return sections
+
+
+def _k_split(x, indices, axis):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    sec = _split_sections(x.shape[axis], num_or_sections)
+    if isinstance(sec, int):
+        indices = sec  # equal split count
+    else:
+        indices = tuple(np.cumsum(sec)[:-1].tolist())
+    out = apply_op("split", _k_split, x, indices=indices, axis=axis)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return list(apply_op(
+        "tensor_split",
+        lambda v, spec, axis: tuple(jnp.array_split(v, spec, axis=axis)),
+        x, spec=num_or_indices, axis=int(axis)))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def _k_tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(v) for v in np.asarray(repeat_times._value)]
+    return apply_op("tile", _k_tile, x, reps=tuple(int(r) for r in repeat_times))
+
+
+def _expand_shape(x, shape):
+    shape = _shape_arg(shape)
+    xs = list(x.shape)
+    out = list(shape)
+    # -1 means keep dim
+    offset = len(out) - len(xs)
+    for i, s in enumerate(out):
+        if s == -1:
+            out[i] = xs[i - offset]
+    return tuple(out)
+
+
+def expand(x, shape, name=None):
+    return apply_op("expand", lambda v, shape: jnp.broadcast_to(v, shape),
+                    x, shape=_expand_shape(x, shape))
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda v, shape: jnp.broadcast_to(v, shape),
+                    x, shape=tuple(y.shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape, name)
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, [1]) if x.ndim == 0 else x for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    def fix(x):
+        if x.ndim == 0:
+            return reshape(x, [1, 1])
+        if x.ndim == 1:
+            return unsqueeze(x, 0)
+        return x
+
+    outs = [fix(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    def fix(x):
+        y = atleast_2d(x)
+        return unsqueeze(y, -1) if y.ndim == 2 else y
+
+    outs = [fix(x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _k_flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return apply_op("flip", _k_flip, x, axis=tuple(int(a) for a in axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v, k, axes: jnp.rot90(v, k=k, axes=axes),
+                    x, k=int(k), axes=tuple(axes))
+
+
+def _k_roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = [int(v) for v in np.asarray(shifts._value).reshape(-1)]
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    else:
+        shifts = int(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return apply_op("roll", _k_roll, x, shifts=shifts, axis=axis)
+
+
+def _k_gather(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(index, Tensor) and index.ndim > 1:
+        index = reshape(index, [-1])
+    return apply_op("gather", _k_gather, x, index, axis=int(axis))
+
+
+def _k_gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return apply_op("gather_nd", _k_gather_nd, x, index)
+
+
+def _k_scatter(x, index, updates, overwrite):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    base = x.at[idx].set(jnp.zeros_like(updates))
+    return base.at[idx].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply_op("scatter", _k_scatter, x, index, updates,
+                    overwrite=bool(overwrite))
+
+
+def _k_scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply_op("scatter_nd_add", _k_scatter_nd_add, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    zero = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zero, index, updates)
+
+
+def _k_index_select(x, index, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", _k_index_select, x,
+                    index if index.ndim == 1 else reshape(index, [-1]),
+                    axis=int(axis))
+
+
+def _k_index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return apply_op("index_sample", _k_index_sample, x, index)
+
+
+def _index_add_impl(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply_op("index_add",
+                    lambda a, idx, v, axis: _index_add_impl(a, idx, axis, v),
+                    x, index, value, axis=int(axis))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _k(a, idx, v, accumulate):
+        ref = a.at[tuple(idx)]
+        return ref.add(v) if accumulate else ref.set(v)
+
+    return apply_op("index_put", _k, x, list(indices), value,
+                    accumulate=bool(accumulate))
+
+
+def _k_masked_gather(x, flat_idx):
+    return jnp.take(x.reshape(-1), flat_idx)
+
+
+def masked_select(x, mask, name=None):
+    # Output shape is data-dependent → eager-only, indices computed on
+    # host (the reference's masked_select allocates dynamically too).
+    m = np.asarray(mask._value)
+    if m.shape != tuple(x.shape):
+        m = np.broadcast_to(m, tuple(x.shape))
+    flat_idx = jnp.asarray(np.flatnonzero(m))
+    return apply_op("masked_select", _k_masked_gather, x, flat_idx=flat_idx)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return apply_op("masked_fill",
+                        lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                        x, mask, value)
+    return apply_op("masked_fill",
+                    lambda a, m, value: jnp.where(m, jnp.asarray(value, a.dtype), a),
+                    x, mask, value=value)
+
+
+def _k_where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", _k_where, condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._value)
+    idx = np.nonzero(arr)
+    from .creation import to_tensor
+
+    if as_tuple:
+        return tuple(to_tensor(i.astype(np.int64).reshape(-1, 1)) for i in idx)
+    return to_tensor(np.stack(idx, axis=1).astype(np.int64))
+
+
+def _k_slice(x, starts, ends, axes):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+
+    return apply_op("slice", _k_slice, x,
+                    starts=tuple(_v(s) for s in starts),
+                    ends=tuple(_v(e) for e in ends),
+                    axes=tuple(int(a) for a in axes))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _k(v, axes, starts, ends, strides):
+        idx = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply_op("strided_slice", _k, x, axes=tuple(axes),
+                    starts=tuple(starts), ends=tuple(ends),
+                    strides=tuple(strides))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = tuple(int(o) for o in (offsets or [0] * x.ndim))
+    def _k(v, shape, offsets):
+        idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+        return v[idx]
+
+    return apply_op("crop", _k, x, shape=shape, offsets=offsets)
+
+
+_PAD_MODE = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}
+
+
+def _k_pad(x, pad_width, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    return jnp.pad(x, pad_width, mode=mode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._value).reshape(-1)]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle "all-dim" layout: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+        width = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # NCHW-style partial spec: pads innermost spatial dims, reversed pairs
+        npairs = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("HWC") or data_format in ("NLC", "NHWC", "NDHWC"):
+            spatial = list(range(1, 1 + npairs))
+        else:
+            spatial = list(range(nd - npairs, nd))
+        for i, ax in enumerate(reversed(spatial)):
+            width[ax] = (pad[2 * i], pad[2 * i + 1])
+        width = tuple(width)
+    return apply_op("pad", _k_pad, x, pad_width=width,
+                    mode=_PAD_MODE.get(mode, mode), value=value)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    def _k(v, axis, n):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(v, n, axis=axis))
+
+    return list(apply_op("unstack", _k, x, axis=int(axis), n=int(n)))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def _k_repeat_interleave(x, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def _k_repeat_interleave_t(x, r, axis, total):
+    return jnp.repeat(x, r, axis=axis, total_repeat_length=total)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        total = int(np.asarray(repeats._value).sum())
+        return apply_op("repeat_interleave", _k_repeat_interleave_t, x, repeats,
+                        axis=None if axis is None else int(axis), total=total)
+    return apply_op("repeat_interleave", _k_repeat_interleave, x,
+                    repeats=int(repeats),
+                    axis=None if axis is None else int(axis))
+
+
+def _k_take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply_op("take_along_axis", _k_take_along_axis, arr, indices,
+                    axis=int(axis))
+
+
+def _k_put_along_axis(x, indices, values, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    moved_idx = indices
+    dims = list(jnp.indices(indices.shape, sparse=True))
+    dims[axis] = moved_idx
+    ref = x.at[tuple(dims)]
+    if reduce == "add":
+        return ref.add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return ref.multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    if not isinstance(values, Tensor):
+        from .creation import full_like
+
+        values = full_like(indices, values, dtype=arr.dtype)
+    return apply_op("put_along_axis", _k_put_along_axis, arr, indices, values,
+                    axis=int(axis), reduce=reduce)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.cc)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple))
+                                     and len(paddings) == 4) else (paddings[0], paddings[1])
+    dh, dw = _pair(dilations)
+
+    def _k(v, kh, kw, sh, sw, ph, pw, dh, dw):
+        n, c, h, w = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, OH, OW]
+        return patches.reshape(n, c * kh * kw, -1)
+
+    return apply_op("unfold", _k, x, kh=kh, kw=kw, sh=sh, sw=sw, ph=ph,
+                    pw=pw, dh=dh, dw=dw)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def _k(v, shape, stride, offset):
+        flat = v.reshape(-1)
+        idx = np.zeros(shape, dtype=np.int64) + offset
+        for dim, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == dim else 1
+                                   for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return apply_op("as_strided", _k, x, shape=tuple(shape),
+                    stride=tuple(stride), offset=int(offset))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def _k(v, value, offset):
+        n = min(v.shape[-2], v.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+        return v.at[..., r, c].set(jnp.asarray(value, v.dtype))
+
+    out = apply_op("fill_diagonal", _k, x, value=value, offset=int(offset))
+    x._value = out._value
+    return x
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def _k(v, offset, dim1, dim2):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+        out = out.at[..., r, c].set(v)
+        # move the two new dims into place
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return apply_op("diag_embed", _k, input, offset=int(offset),
+                    dim1=int(dim1), dim2=int(dim2))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def _k(v, index_num, nshards, shard_id, ignore_value):
+        size = (index_num + nshards - 1) // nshards
+        lo = shard_id * size
+        inside = (v >= lo) & (v < lo + size)
+        return jnp.where(inside, v - lo, ignore_value)
+
+    return apply_op("shard_index", _k, input, index_num=int(index_num),
+                    nshards=int(nshards), shard_id=int(shard_id),
+                    ignore_value=int(ignore_value))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+# -- getitem ------------------------------------------------------------
+
+
+def _convert_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _k_getitem(v, idx):
+    return v[idx]
+
+
+def getitem(x, idx):
+    # Array indices ride along as (unhashable) kwargs → the dispatcher
+    # skips the per-op jit cache for them; plain int/slice indices hash
+    # and hit the cache. Only x is differentiated.
+    return apply_op("getitem", _k_getitem, x, idx=_convert_index(idx))

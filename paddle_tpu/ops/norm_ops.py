@@ -1,0 +1,174 @@
+"""Normalization kernels (reference: paddle/phi/kernels layer_norm,
+operators/batch_norm_op.*, group_norm_op.*, instance_norm_op.*).
+
+batch_norm returns (out, new_mean, new_var) — running-stat updates are
+value-level (functional), the caller (nn.BatchNorm) commits them to its
+buffers; this keeps the kernel pure for XLA while preserving the
+reference's in-place running-stat semantics at the layer level."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+
+__all__ = [
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "rms_norm",
+    "normalize", "local_response_norm",
+]
+
+
+def _k_layer_norm(x, weight, bias, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x.astype(jnp.float32), axis=axes, keepdims=True)
+    var = jnp.var(x.astype(jnp.float32), axis=axes, keepdims=True)
+    out = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+    out = out.astype(x.dtype)
+    shape = x.shape[begin_axis:]
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(normalized_shape) if normalized_shape is not None else 1
+    begin = x.ndim - n_norm
+    return apply_op("layer_norm", _k_layer_norm, x, weight, bias,
+                    eps=float(epsilon), begin_axis=begin)
+
+
+def _k_rms_norm(x, weight, eps):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    return apply_op("rms_norm", _k_rms_norm, x, weight, eps=float(epsilon))
+
+
+def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
+                  channel_axis):
+    reduce_axes = tuple(a for a in range(x.ndim) if a != channel_axis)
+    if training:
+        xf = x.astype(jnp.float32)
+        batch_mean = jnp.mean(xf, axis=reduce_axes)
+        batch_var = jnp.var(xf, axis=reduce_axes)
+        use_mean, use_var = batch_mean, batch_var
+        n = x.size // x.shape[channel_axis]
+        unbiased = batch_var * (n / max(n - 1, 1))
+        new_mean = momentum * mean + (1 - momentum) * batch_mean
+        new_var = momentum * var + (1 - momentum) * unbiased
+    else:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    out = ((x.astype(jnp.float32) - use_mean.reshape(shape))
+           * jax.lax.rsqrt(use_var.reshape(shape) + eps))
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    if use_global_stats:
+        training = False
+    ca = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else (
+        1 if x.ndim > 1 else 0)
+    out, new_mean, new_var = apply_op(
+        "batch_norm", _k_batch_norm, x, running_mean, running_var, weight,
+        bias, eps=float(epsilon), momentum=float(momentum),
+        training=bool(training), channel_axis=ca)
+    return out, new_mean, new_var
+
+
+def _k_instance_norm(x, weight, bias, eps):
+    # x: [N, C, *spatial]
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    return apply_op("instance_norm", _k_instance_norm, x, weight, bias,
+                    eps=float(eps))
+
+
+def _k_group_norm(x, weight, bias, groups, eps, channel_last):
+    if channel_last:
+        x_m = jnp.moveaxis(x, -1, 1)
+    else:
+        x_m = x
+    n, c = x_m.shape[0], x_m.shape[1]
+    g = x_m.reshape((n, groups, c // groups) + x_m.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(x_m.shape)
+    shape = (1, -1) + (1,) * (x_m.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return apply_op("group_norm", _k_group_norm, x, weight, bias,
+                    groups=int(num_groups), eps=float(epsilon),
+                    channel_last=data_format in ("NHWC", "NLC", "NDHWC"))
+
+
+def _k_normalize(x, p, axis, eps):
+    n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, eps)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op("normalize", _k_normalize, x, p=float(p), axis=int(axis),
+                    eps=float(epsilon))
+
+
+def _k_lrn(x, size, alpha, beta, k):
+    # across-channel LRN on NCHW
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    sq_p = jnp.pad(sq, pad)
+    window = [1, size] + [1] * (x.ndim - 2)
+    import numpy as np
+
+    s = jax.lax.reduce_window(sq_p, np.asarray(0, x.dtype), jax.lax.add,
+                              window, [1] * x.ndim, "VALID")
+    return x / jnp.power(k + alpha * s / size, beta)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return apply_op("local_response_norm", _k_lrn, x, size=int(size),
+                    alpha=float(alpha), beta=float(beta), k=float(k))

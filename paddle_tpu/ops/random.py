@@ -1,0 +1,241 @@
+"""Random ops + global RNG state.
+
+Parity target: python/paddle/tensor/random.py, paddle.seed
+(python/paddle/framework/random.py), and the model-parallel
+RNGStatesTracker (fleet/meta_parallel/parallel_layers/random.py:32).
+
+TPU-native design: the stateful cuRAND generator is replaced by a
+*stateless* threefry PRNG: a base key (set by `seed`) plus a
+monotonically increasing call counter, combined with `fold_in`. Inside
+`to_static`/jit tracing the counter is a traced value provided by the
+harness so each compiled step draws fresh randomness — the functional
+analog of the generator state advancing.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.engine import apply_op, in_trace_mode
+from ..core.tensor import Tensor
+
+__all__ = [
+    "seed", "get_rng_state", "set_rng_state", "uniform", "uniform_",
+    "normal", "gauss", "randn", "rand", "randint", "randint_like",
+    "randperm", "multinomial", "bernoulli", "poisson", "standard_normal",
+    "exponential_", "binomial", "log_normal", "rayleigh", "cauchy_",
+    "next_key",
+]
+
+
+class _RNG(threading.local):
+    def __init__(self):
+        self.base = jax.random.key(0)
+        self.counter = 0
+        self.traced_key = None  # pushed by the jit harness during tracing
+        self.trace_counter = 0
+
+
+_rng = _RNG()
+
+
+def seed(s: int):
+    _rng.base = jax.random.key(int(s))
+    _rng.counter = 0
+    return _rng.base
+
+
+def get_rng_state():
+    return (jax.random.key_data(_rng.base), _rng.counter)
+
+
+def set_rng_state(state):
+    data, counter = state
+    _rng.base = jax.random.wrap_key_data(jnp.asarray(data))
+    _rng.counter = int(counter)
+
+
+def push_traced_key(key):
+    """jit harness hook: base randomness on a traced key during tracing."""
+    prev = _rng.traced_key
+    _rng.traced_key = key
+    _rng.trace_counter = 0
+    return prev
+
+
+def pop_traced_key(prev):
+    _rng.traced_key = prev
+
+
+def next_key():
+    if in_trace_mode() and _rng.traced_key is not None:
+        _rng.trace_counter += 1
+        return jax.random.fold_in(_rng.traced_key, _rng.trace_counter)
+    _rng.counter += 1
+    return jax.random.fold_in(_rng.base, _rng.counter)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _wrap(val):
+    t = Tensor(val, _internal=True)
+    if not in_trace_mode():
+        from ..core.place import current_device
+
+        t._value = jax.device_put(val, current_device())
+    return t
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    key = next_key()
+    return _wrap(jax.random.uniform(key, _shape(shape), dtype=dt,
+                                    minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = next_key()
+    x._value = jax.random.uniform(key, tuple(x.shape), dtype=x.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shp = tuple(mean.shape) if isinstance(mean, Tensor) else tuple(std.shape)
+        key = next_key()
+
+        def _k(m, s, key):
+            return m + s * jax.random.normal(key, shp, dtype=default_float_dtype())
+
+        return apply_op("normal", _k, mean, std, key=key)
+    dt = default_float_dtype()
+    key = next_key()
+    return _wrap(mean + std * jax.random.normal(key, _shape(shape or [1]), dtype=dt))
+
+
+def gauss(mean=0.0, std=1.0, shape=None, name=None):
+    return normal(mean, std, shape, name)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or default_float_dtype()
+    return _wrap(jax.random.normal(next_key(), _shape(shape), dtype=dt))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype, name)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype)
+    return _wrap(jax.random.randint(next_key(), _shape(shape), low, high,
+                                    dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = convert_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    return _wrap(jax.random.randint(next_key(), tuple(x.shape), low, high,
+                                    dtype=dt if jnp.issubdtype(dt, jnp.integer)
+                                    else jnp.int64).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    return _wrap(jax.random.permutation(next_key(), int(n)).astype(dt))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = next_key()
+
+    def _k(probs, key, num_samples, replacement):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(num_samples,) + probs.shape[:-1]).swapaxes(0, -1) \
+                if probs.ndim > 1 else jax.random.categorical(
+                    key, logits, shape=(num_samples,))
+        # without replacement: gumbel top-k
+        g = jax.random.gumbel(key, probs.shape, dtype=logits.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+
+    out = apply_op("multinomial", _k, x, key=key,
+                   num_samples=int(num_samples), replacement=bool(replacement))
+    return out.astype("int64")
+
+
+def bernoulli(x, name=None):
+    key = next_key()
+
+    def _k(p, key):
+        return jax.random.bernoulli(key, p).astype(p.dtype)
+
+    return apply_op("bernoulli", _k, x, key=key)
+
+
+def poisson(x, name=None):
+    key = next_key()
+
+    def _k(lam, key):
+        return jax.random.poisson(key, lam).astype(lam.dtype)
+
+    return apply_op("poisson", _k, x, key=key)
+
+
+def binomial(count, prob, name=None):
+    key = next_key()
+
+    def _k(n, p, key):
+        return jax.random.binomial(key, n, p).astype(jnp.int64)
+
+    return apply_op("binomial", _k, count, prob, key=key)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = next_key()
+    x._value = (jax.random.exponential(key, tuple(x.shape), dtype=x.dtype)
+                / lam)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    key = next_key()
+    dt = default_float_dtype()
+    return _wrap(jnp.exp(mean + std * jax.random.normal(key, _shape(shape or [1]),
+                                                        dtype=dt)))
+
+
+def rayleigh(scale=1.0, shape=None, name=None):
+    key = next_key()
+    dt = default_float_dtype()
+    u = jax.random.uniform(key, _shape(shape or [1]), dtype=dt,
+                           minval=1e-7, maxval=1.0)
+    return _wrap(scale * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    key = next_key()
+    x._value = (loc + scale * jax.random.cauchy(key, tuple(x.shape),
+                                                dtype=x.dtype))
+    return x

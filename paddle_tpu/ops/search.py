@@ -1,0 +1,201 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py,
+paddle/phi/kernels top_k/arg_min_max/masked_select...)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "unique",
+    "unique_consecutive", "searchsorted", "kthvalue", "mode", "index_sample",
+    "bucketize",
+]
+
+
+def _k_argmax(x, axis, keepdim, dtype):
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1), axis=0)
+        return out.astype(dtype)
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op("argmax", _k_argmax, x,
+                    axis=None if axis is None else int(axis),
+                    keepdim=bool(keepdim), dtype=convert_dtype(dtype))
+
+
+def _k_argmin(x, axis, keepdim, dtype):
+    if axis is None:
+        return jnp.argmin(x.reshape(-1), axis=0).astype(dtype)
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op("argmin", _k_argmin, x,
+                    axis=None if axis is None else int(axis),
+                    keepdim=bool(keepdim), dtype=convert_dtype(dtype))
+
+
+def _k_argsort(x, axis, descending, stable):
+    out = jnp.argsort(x, axis=axis, stable=stable,
+                      descending=descending)
+    return out.astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_op("argsort", _k_argsort, x, axis=int(axis),
+                    descending=bool(descending), stable=bool(stable))
+
+
+def _k_sort(x, axis, descending, stable):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_op("sort", _k_sort, x, axis=int(axis),
+                    descending=bool(descending), stable=bool(stable))
+
+
+def _k_topk(x, k, axis, largest, sorted_):
+    nd = x.ndim
+    ax = axis % nd
+    moved = jnp.moveaxis(x, ax, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    out = apply_op("topk", _k_topk, x, k=int(k),
+                   axis=int(axis) if axis is not None else -1,
+                   largest=bool(largest), sorted_=bool(sorted))
+    return tuple(out)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape → host computation, results placed on device
+    arr = np.asarray(x._value)
+    res = np.unique(arr, return_index=True, return_inverse=True,
+                    return_counts=True, axis=axis)
+    vals, index, inverse, counts = res
+    from .creation import to_tensor
+
+    outs = [to_tensor(vals)]
+    if return_index:
+        outs.append(to_tensor(index.astype(np.int64)))
+    if return_inverse:
+        outs.append(to_tensor(inverse.astype(np.int64)))
+    if return_counts:
+        outs.append(to_tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.ones(arr.shape[0], dtype=bool)
+        keep[1:] = arr[1:] != arr[:-1]
+        vals = arr[keep]
+        inverse = np.cumsum(keep) - 1
+        counts = np.diff(np.append(np.flatnonzero(keep), arr.shape[0]))
+    else:
+        raise NotImplementedError("axis for unique_consecutive")
+    from .creation import to_tensor
+
+    outs = [to_tensor(vals)]
+    if return_inverse:
+        outs.append(to_tensor(inverse.astype(np.int64)))
+    if return_counts:
+        outs.append(to_tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def _k_searchsorted(sorted_sequence, values, right):
+    return jnp.searchsorted(sorted_sequence, values,
+                            side="right" if right else "left").astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    out = apply_op("searchsorted", _k_searchsorted, sorted_sequence, values,
+                   right=bool(right))
+    return out.astype("int32") if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def _k_kthvalue(x, k, axis, keepdim):
+    nd = x.ndim
+    ax = axis % nd
+    moved = jnp.moveaxis(x, ax, -1)
+    vals = jnp.sort(moved, axis=-1)[..., k - 1]
+    idx = jnp.argsort(moved, axis=-1)[..., k - 1].astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    out = apply_op("kthvalue", _k_kthvalue, x, k=int(k), axis=int(axis),
+                   keepdim=bool(keepdim))
+    return tuple(out)
+
+
+def _k_mode(x, axis, keepdim):
+    nd = x.ndim
+    ax = axis % nd
+    moved = jnp.moveaxis(x, ax, -1)
+    srt = jnp.sort(moved, axis=-1)
+    n = srt.shape[-1]
+    # count runs: mode = value with max run length
+    eq = srt[..., 1:] == srt[..., :-1]
+    run = jnp.concatenate([jnp.zeros_like(srt[..., :1], dtype=jnp.int32),
+                           jnp.cumsum(eq.astype(jnp.int32), axis=-1)], axis=-1)
+    # run length at i resets when not equal — recompute via segment trick
+    def scan_fn(carry, xs):
+        v, e = xs
+        new = jnp.where(e, carry + 1, 1)
+        return new, new
+
+    eqf = jnp.concatenate([jnp.zeros_like(srt[..., :1], dtype=bool), eq], axis=-1)
+    _, lens = jax.lax.scan(scan_fn, jnp.ones_like(srt[..., 0], dtype=jnp.int32),
+                           (jnp.moveaxis(srt, -1, 0), jnp.moveaxis(eqf, -1, 0)))
+    lens = jnp.moveaxis(lens, 0, -1)
+    best = jnp.argmax(lens, axis=-1)
+    vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    # index of the mode value in the original array (first occurrence)
+    match = moved == vals[..., None]
+    idx = jnp.argmax(match, axis=-1).astype(jnp.int64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    out = apply_op("mode", _k_mode, x, axis=int(axis), keepdim=bool(keepdim))
+    return tuple(out)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+
+    return _is(x, index)

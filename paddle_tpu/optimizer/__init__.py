@@ -1,0 +1,292 @@
+"""paddle.optimizer (reference: python/paddle/optimizer/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+from . import lr
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "lr",
+]
+
+
+class SGD(Optimizer):
+    """reference: optimizer.py SGD / phi sgd kernel."""
+
+    _slot_names = ()
+
+    def _update(self, param, grad, slots, lr):
+        new_p = param.astype(jnp.float32) - lr * grad
+        return new_p.astype(param.dtype), slots
+
+
+class Momentum(Optimizer):
+    """reference: Momentum (use_nesterov option, momentum_op)."""
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update(self, param, grad, slots, lr):
+        v = slots["velocity"] * self._momentum + grad
+        if self._use_nesterov:
+            step = grad + self._momentum * v
+        else:
+            step = v
+        new_p = param.astype(jnp.float32) - lr * step
+        return new_p.astype(param.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: Adam (adam_op; beta pows as accumulators)."""
+
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        slots["beta1_pow"] = jnp.ones((), jnp.float32)
+        slots["beta2_pow"] = jnp.ones((), jnp.float32)
+        return slots
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        for name in st:
+            st[name]["beta1_pow"] = jnp.ones((), jnp.float32)
+            st[name]["beta2_pow"] = jnp.ones((), jnp.float32)
+        return st
+
+    def _update(self, param, grad, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment1"] + (1 - b1) * grad
+        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        new_p = param.astype(jnp.float32) - lr * mhat / (
+            jnp.sqrt(vhat) + eps)
+        return new_p.astype(param.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """reference: AdamW — decoupled weight decay."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, param, grad, slots, lr):
+        wd = self._wd_coeff()
+        apply_decay = True
+        if (self._apply_decay_param_fun is not None
+                and self._current_param_name is not None):
+            apply_decay = self._apply_decay_param_fun(
+                self._current_param_name)
+        p32 = param.astype(jnp.float32)
+        if wd and apply_decay:
+            p32 = p32 * (1.0 - lr * wd)
+        new_p, new_slots = Adam._update(self, p32, grad, slots, lr)
+        return new_p.astype(param.dtype), new_slots
+
+    @property
+    def _decoupled(self):
+        return True
+
+
+class Adamax(Optimizer):
+    _slot_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        slots["beta1_pow"] = jnp.ones((), jnp.float32)
+        return slots
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        for name in st:
+            st[name]["beta1_pow"] = jnp.ones((), jnp.float32)
+        return st
+
+    def _update(self, param, grad, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(grad) + eps)
+        b1p = slots["beta1_pow"] * b1
+        new_p = param.astype(jnp.float32) - (lr / (1 - b1p)) * m / u
+        return new_p.astype(param.dtype), {
+            "moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_slots(self, p):
+        return {"moment": jnp.full(tuple(p.shape), self._init_acc,
+                                   jnp.float32)}
+
+    def _update(self, param, grad, slots, lr):
+        m = slots["moment"] + grad * grad
+        new_p = param.astype(jnp.float32) - lr * grad / (
+            jnp.sqrt(m) + self._epsilon)
+        return new_p.astype(param.dtype), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update(self, param, grad, slots, lr):
+        rho, eps = self._rho, self._epsilon
+        ag = rho * slots["avg_squared_grad"] + (1 - rho) * grad * grad
+        update = -jnp.sqrt((slots["avg_squared_update"] + eps) / (ag + eps)) * grad
+        au = rho * slots["avg_squared_update"] + (1 - rho) * update * update
+        new_p = param.astype(jnp.float32) + lr * update
+        return new_p.astype(param.dtype), {
+            "avg_squared_grad": ag, "avg_squared_update": au}
+
+
+class RMSProp(Optimizer):
+    _slot_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update(self, param, grad, slots, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * slots["mean_square"] + (1 - rho) * grad * grad
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * slots["momentum_acc"] + lr * grad / denom
+        new_p = param.astype(jnp.float32) - mom
+        return new_p.astype(param.dtype), {
+            "mean_square": ms, "mean_grad": mg, "momentum_acc": mom}
+
+
+class Lamb(Optimizer):
+    """reference: Lamb (lamb_op) — layerwise adaptive large-batch."""
+
+    _slot_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        slots["beta1_pow"] = jnp.ones((), jnp.float32)
+        slots["beta2_pow"] = jnp.ones((), jnp.float32)
+        return slots
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        for name in st:
+            st[name]["beta1_pow"] = jnp.ones((), jnp.float32)
+            st[name]["beta2_pow"] = jnp.ones((), jnp.float32)
+        return st
+
+    def _update(self, param, grad, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment1"] + (1 - b1) * grad
+        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        p32 = param.astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(param.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class LarsMomentum(Optimizer):
+    """reference: fluid LarsMomentumOptimizer (lars_momentum_op)."""
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, name=None, epsilon=0):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _update(self, param, grad, slots, lr):
+        p32 = param.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(grad)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._eps), lr)
+        v = self._momentum * slots["velocity"] + local_lr * (
+            grad + self._lars_wd * p32)
+        new_p = p32 - v
+        return new_p.astype(param.dtype), {"velocity": v}

@@ -1,0 +1,187 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+TPU-native design: every optimizer defines ONE pure update rule
+`_update(param, grad, slots, lr, **hp) -> (new_param, new_slots)` in
+jnp. Dygraph `step()` runs it eagerly per parameter; the jit train-step
+harness (paddle_tpu/jit) calls the same rule inside the compiled step
+so forward+backward+update fuse into a single XLA program (the analog
+of the reference's fused_adam / multi_tensor paths).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    _slot_names = ()  # e.g. ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups: flatten (group-specific lr unsupported yet)
+                flat = []
+                for g in parameters:
+                    flat.extend(g["params"])
+                parameters = flat
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators = {}  # param name -> {slot: jnp array}
+        self._step_count = 0
+        self._current_param_name = None  # set per-param during step()
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self):
+        lr = self._learning_rate
+        if isinstance(lr, LRScheduler):
+            return float(lr())
+        return float(lr)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "can't set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- slots ------------------------------------------------------------
+    def _get_slots(self, p: Tensor):
+        key = p.name
+        slots = self._accumulators.get(key)
+        if slots is None:
+            slots = self._create_slots(p)
+            self._accumulators[key] = slots
+        return slots
+
+    def _create_slots(self, p: Tensor):
+        return {name: jnp.zeros(tuple(p.shape), jnp.float32)
+                for name in self._slot_names}
+
+    # -- core rule (override) ---------------------------------------------
+    def _update(self, param, grad, slots, lr):
+        raise NotImplementedError
+
+    def _wd_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # L2Decay object
+            return float(wd._coeff)
+        return float(wd)
+
+    # -- dygraph step -----------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = self._parameter_list or []
+        lr = self.get_lr()
+        grads_and_params = [(p, p._grad) for p in params
+                            if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip(
+                [(p, g) for p, g in grads_and_params])
+            grads_and_params = clipped
+        wd = self._wd_coeff()
+        decoupled = getattr(self, "_decoupled_wd", False)
+        for p, g in grads_and_params:
+            gv = g._value if isinstance(g, Tensor) else g
+            gv = gv.astype(jnp.float32)
+            pv = p._value
+            if wd and not decoupled:
+                gv = gv + wd * pv.astype(jnp.float32)
+            slots = self._get_slots(p)
+            self._current_param_name = p.name
+            new_p, new_slots = self._update(pv, gv, slots, lr)
+            p._value = new_p
+            self._accumulators[p.name] = new_slots
+        self._current_param_name = None
+        self._step_count += 1
+
+    minimize_step = step
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in (self._parameter_list or []):
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- functional API for the jit harness -------------------------------
+    def init_state(self, params: dict):
+        """params: name -> array. Returns state pytree."""
+        return {name: {s: jnp.zeros(v.shape, jnp.float32)
+                       for s in self._slot_names}
+                for name, v in params.items()}
+
+    def apply_gradients(self, params: dict, grads: dict, state: dict, lr):
+        """Pure: used inside jit. Applies clip + wd + rule."""
+        if self._grad_clip is not None:
+            grads = self._grad_clip.functional_clip(grads)
+        wd = self._wd_coeff()
+        decoupled = getattr(self, "_decoupled_wd", False)
+        new_params, new_state = {}, {}
+        for name, pv in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = pv
+                new_state[name] = state[name]
+                continue
+            g = g.astype(jnp.float32)
+            if wd and not decoupled:
+                g = g + wd * pv.astype(jnp.float32)
+            np_, ns_ = self._update(pv, g, state[name], lr)
+            new_params[name] = np_
+            new_state[name] = ns_
+        return new_params, new_state
+
+    # -- state dict -------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        for pname, slots in self._accumulators.items():
+            for sname, v in slots.items():
+                out[f"{pname}.{sname}"] = Tensor(np.asarray(v))
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, v in state_dict.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            pname, _, sname = key.rpartition(".")
+            arr = v._value if isinstance(v, Tensor) else jnp.asarray(
+                np.asarray(v))
+            self._accumulators.setdefault(pname, {})[sname] = arr
+
+    set_dict = set_state_dict
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list

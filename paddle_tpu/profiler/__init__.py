@@ -1,0 +1,203 @@
+"""paddle.profiler (reference: paddle/fluid/platform/profiler/ —
+Profiler, RecordEvent, chrome-trace export; python/paddle/profiler/).
+
+TPU-native: host events via perf_counter spans (HostTracer analog);
+device timeline via jax.profiler (XPlane — the TPU-native equivalent of
+CUPTI activity records), exportable to TensorBoard; chrome-trace JSON
+export of host events for tools/timeline.py parity."""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "start_profiler", "stop_profiler"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class _Recorder(threading.local):
+    def __init__(self):
+        self.events = []
+        self.active = False
+
+
+_recorder = _Recorder()
+
+
+class RecordEvent:
+    """RAII host-event annotation (reference: platform/profiler.h
+    RecordEvent, used at every TraceOp)."""
+
+    def __init__(self, name, event_type="UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter()
+
+    def end(self):
+        if self._begin is None:
+            return
+        if _recorder.active:
+            _recorder.events.append(
+                (self.name, self.event_type, self._begin,
+                 time.perf_counter(), threading.get_ident()))
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        cycle = closed + ready + record
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof.export(os.path.join(dir_name,
+                                 (worker_name or "worker") + ".json"),
+                    format="json")
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._jax_dir = None
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        _recorder.active = True
+        _recorder.events = []
+        self._last_step_t = time.perf_counter()
+        if ProfilerTarget.TPU in self._targets and not self._timer_only:
+            import tempfile
+
+            self._jax_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            try:
+                import jax
+
+                jax.profiler.start_trace(self._jax_dir)
+            except Exception:
+                self._jax_dir = None
+
+    def stop(self):
+        _recorder.active = False
+        if self._jax_dir is not None:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times) / len(self._step_times)
+        return f"avg step time: {avg * 1000:.3f} ms"
+
+    def export(self, path, format="json"):
+        events = [{
+            "name": name, "cat": cat, "ph": "X",
+            "ts": begin * 1e6, "dur": (end - begin) * 1e6,
+            "pid": 0, "tid": tid,
+        } for name, cat, begin, end, tid in _recorder.events]
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg = {}
+        for name, _, b, e, _ in _recorder.events:
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + (e - b), cnt + 1)
+        lines = [f"{'Event':40s} {'Calls':>8s} {'Total(ms)':>12s}"]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:40s} {cnt:8d} {tot * 1000:12.3f}")
+        return "\n".join(lines)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+_global_prof = None
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    global _global_prof
+    _global_prof = Profiler()
+    _global_prof.start()
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _global_prof
+    if _global_prof is not None:
+        _global_prof.stop()
+        _global_prof.export(profile_path + ".json")
+        _global_prof = None

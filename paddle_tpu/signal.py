@@ -1,0 +1,104 @@
+"""paddle.signal (reference: python/paddle/signal.py — stft/istft/frame)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.engine import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def _k(v, frame_length, hop_length, axis):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (np.arange(frame_length)[:, None]
+               + hop_length * np.arange(num)[None, :])
+        moved = jnp.moveaxis(v, axis, -1)
+        framed = moved[..., idx]  # [..., frame_length, num]
+        return framed if axis in (-1, v.ndim - 1) else jnp.moveaxis(
+            framed, (-2, -1), (axis, axis + 1))
+
+    return apply_op("frame", _k, x, frame_length=int(frame_length),
+                    hop_length=int(hop_length), axis=int(axis))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def _k(v, hop_length):
+        # v: [..., frame_length, num]
+        fl, num = v.shape[-2], v.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                v[..., i])
+        return out
+
+    return apply_op("overlap_add", _k, x, hop_length=int(hop_length))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else (
+        jnp.ones(win_length, jnp.float32) if window is None
+        else jnp.asarray(window))
+
+    def _k(v, w, n_fft, hop, center, normalized, onesided, pad_mode):
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        idx = (np.arange(n_fft)[None, :]
+               + hop * np.arange(num)[:, None])
+        frames = v[..., idx] * w  # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num]
+
+    return apply_op("stft", _k, x, w=wv, n_fft=int(n_fft), hop=int(hop),
+                    center=bool(center), normalized=bool(normalized),
+                    onesided=bool(onesided), pad_mode=pad_mode)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else (
+        jnp.ones(win_length, jnp.float32) if window is None
+        else jnp.asarray(window))
+
+    def _k(v, w, n_fft, hop, center, normalized, onesided, length):
+        spec = jnp.swapaxes(v, -1, -2)  # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * w
+        num = frames.shape[-2]
+        n = n_fft + hop * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        norm = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop:i * hop + n_fft].add(frames[..., i, :])
+            norm = norm.at[i * hop:i * hop + n_fft].add(w * w)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2) or None]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op("istft", _k, x, w=wv, n_fft=int(n_fft), hop=int(hop),
+                    center=bool(center), normalized=bool(normalized),
+                    onesided=bool(onesided), length=length)

@@ -1,0 +1,76 @@
+"""paddle.sparse (reference: paddle/phi/core/sparse_coo_tensor.h,
+python/paddle/sparse). Round-1: COO/CSR containers + conversions +
+basic ops; TPU kernels operate on densified segments (XLA has no
+first-class sparse)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices_t = indices
+        self.values_t = values
+        self.dense_shape = list(shape)
+
+    def indices(self):
+        return self.indices_t
+
+    def values(self):
+        return self.values_t
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    def to_dense(self):
+        idx = np.asarray(self.indices_t._value)
+        vals = self.values_t._value
+        out = jnp.zeros(tuple(self.dense_shape), vals.dtype)
+        out = out.at[tuple(idx)].add(vals)
+        return Tensor(out, _internal=True)
+
+    def is_sparse(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if not isinstance(indices, Tensor):
+        indices = to_tensor(np.asarray(indices))
+    if not isinstance(values, Tensor):
+        values = to_tensor(np.asarray(values))
+    if shape is None:
+        idx = np.asarray(indices._value)
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape)
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows_t = crows
+        self.cols_t = cols
+        self.values_t = values
+        self.dense_shape = list(shape)
+
+    def to_dense(self):
+        crows = np.asarray(self.crows_t._value)
+        cols = np.asarray(self.cols_t._value)
+        vals = self.values_t._value
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        out = jnp.zeros(tuple(self.dense_shape), vals.dtype)
+        out = out.at[rows, cols].add(vals)
+        return Tensor(out, _internal=True)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    def conv(x):
+        return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+    return SparseCsrTensor(conv(crows), conv(cols), conv(values), shape)

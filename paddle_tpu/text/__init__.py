@@ -1,0 +1,112 @@
+"""paddle.text (reference: python/paddle/text/datasets/). Synthetic
+fallbacks in zero-egress environments."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "Movielens",
+           "Conll05st", "ViterbiDecoder", "viterbi_decode"]
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.x[idx], np.asarray([self.y[idx]], np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _SyntheticSeqDataset(Dataset):
+    VOCAB = 1000
+    LEN = 32
+    N = 512
+
+    def __init__(self, data_file=None, mode="train", download=True, **kw):
+        rng = np.random.RandomState(3 if mode == "train" else 5)
+        self.seqs = rng.randint(1, self.VOCAB, (self.N, self.LEN)).astype(
+            np.int64)
+        self.labels = rng.randint(0, 2, self.N).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.seqs[idx], self.labels[idx]
+
+    def __len__(self):
+        return self.N
+
+
+class Imdb(_SyntheticSeqDataset):
+    pass
+
+
+class Imikolov(_SyntheticSeqDataset):
+    pass
+
+
+class WMT14(_SyntheticSeqDataset):
+    pass
+
+
+class WMT16(_SyntheticSeqDataset):
+    pass
+
+
+class Movielens(_SyntheticSeqDataset):
+    pass
+
+
+class Conll05st(_SyntheticSeqDataset):
+    pass
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    import jax.numpy as jnp
+
+    from ..core.engine import apply_op
+
+    def _k(emissions, trans):
+        # emissions: [B, T, N]; trans: [N, N]
+        def step(carry, e_t):
+            score = carry  # [B, N]
+            broadcast = score[:, :, None] + trans[None, :, :]
+            best = jnp.max(broadcast, axis=1)
+            idx = jnp.argmax(broadcast, axis=1)
+            return best + e_t, idx
+
+        import jax
+
+        first = emissions[:, 0]
+        rest = jnp.moveaxis(emissions[:, 1:], 1, 0)
+        last, idxs = jax.lax.scan(step, first, rest)
+        best_last = jnp.argmax(last, axis=-1)
+
+        def back(carry, idx_t):
+            nxt = carry
+            prev = jnp.take_along_axis(idx_t, nxt[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, best_last, idxs[::-1])
+        path = jnp.concatenate([path_rev[::-1],
+                                best_last[None, :]], axis=0)
+        return jnp.max(last, axis=-1), jnp.moveaxis(path, 0, 1)
+
+    scores, path = apply_op("viterbi_decode", _k, potentials,
+                            transition_params)
+    return scores, path
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

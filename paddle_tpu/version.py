@@ -1,0 +1,12 @@
+"""Version info (reference: python/paddle/version.py, generated)."""
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+full_version = f"{major}.{minor}.{patch}"
+commit = "unknown"
+istaged = False
+
+
+def show():
+    print(f"paddle_tpu {full_version}")

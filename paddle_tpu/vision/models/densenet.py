@@ -1,0 +1,102 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {121: (64, 32, [6, 12, 24, 16]), 161: (96, 48, [6, 12, 36, 24]),
+        169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32]),
+        264: (64, 32, [6, 12, 64, 48])}
+
+
+class DenseLayer(Layer):
+    def __init__(self, inp, growth_rate, bn_size):
+        super().__init__()
+        self.norm1 = BatchNorm2D(inp)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(inp, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        return concat([x, out], axis=1)
+
+
+class Transition(Layer):
+    def __init__(self, inp, oup):
+        super().__init__()
+        self.norm = BatchNorm2D(inp)
+        self.relu = ReLU()
+        self.conv = Conv2D(inp, oup, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init, growth, block_cfg = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [Conv2D(3, num_init, 7, stride=2, padding=3,
+                        bias_attr=False),
+                 BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1)]
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(block_cfg) - 1:
+                feats.append(Transition(ch, ch // 2))
+                ch //= 2
+        feats.extend([BatchNorm2D(ch), ReLU()])
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def _densenet(depth, pretrained, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return DenseNet(layers=depth, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,149 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py).
+Compact faithful variant (A/B/C/D/E blocks)."""
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def conv_bn(inp, oup, kernel, stride=1, padding=0):
+    return Sequential(
+        Conv2D(inp, oup, kernel, stride=stride, padding=padding,
+               bias_attr=False),
+        BatchNorm2D(oup), ReLU())
+
+
+class InceptionA(Layer):
+    def __init__(self, inp, pool_features):
+        super().__init__()
+        self.b1 = conv_bn(inp, 64, 1)
+        self.b5 = Sequential(conv_bn(inp, 48, 1), conv_bn(48, 64, 5,
+                                                          padding=2))
+        self.b3 = Sequential(conv_bn(inp, 64, 1),
+                             conv_bn(64, 96, 3, padding=1),
+                             conv_bn(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             conv_bn(inp, pool_features, 1))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = conv_bn(inp, 384, 3, stride=2)
+        self.b3d = Sequential(conv_bn(inp, 64, 1),
+                              conv_bn(64, 96, 3, padding=1),
+                              conv_bn(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, inp, c7):
+        super().__init__()
+        self.b1 = conv_bn(inp, 192, 1)
+        self.b7 = Sequential(conv_bn(inp, c7, 1),
+                             conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+                             conv_bn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(conv_bn(inp, c7, 1),
+                              conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+                              conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+                              conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+                              conv_bn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             conv_bn(inp, 192, 1))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = Sequential(conv_bn(inp, 192, 1),
+                             conv_bn(192, 320, 3, stride=2))
+        self.b7 = Sequential(conv_bn(inp, 192, 1),
+                             conv_bn(192, 192, (1, 7), padding=(0, 3)),
+                             conv_bn(192, 192, (7, 1), padding=(3, 0)),
+                             conv_bn(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = conv_bn(inp, 320, 1)
+        self.b3_1 = conv_bn(inp, 384, 1)
+        self.b3_2a = conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = Sequential(conv_bn(inp, 448, 1),
+                               conv_bn(448, 384, 3, padding=1))
+        self.bd_2a = conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             conv_bn(inp, 192, 1))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+
+        b3 = self.b3_1(x)
+        b3 = concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1)
+        bd = self.bd_1(x)
+        bd = concat([self.bd_2a(bd), self.bd_2b(bd)], axis=1)
+        return concat([self.b1(x), b3, bd, self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            conv_bn(3, 32, 3, stride=2), conv_bn(32, 32, 3),
+            conv_bn(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            conv_bn(64, 80, 1), conv_bn(80, 192, 3), MaxPool2D(3, 2))
+        self.mixed = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return InceptionV3(**kwargs)

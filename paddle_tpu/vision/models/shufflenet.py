@@ -1,0 +1,116 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, ChannelShuffle, Conv2D,
+                   Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]
+
+_CFG = {"0.25": [24, 24, 48, 96, 512], "0.5": [24, 48, 96, 192, 1024],
+        "1.0": [24, 116, 232, 464, 1024], "1.5": [24, 176, 352, 704, 1024],
+        "2.0": [24, 244, 488, 976, 2048]}
+
+
+def _cb(inp, oup, k, stride=1, padding=0, groups=1, act=True):
+    layers = [Conv2D(inp, oup, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(oup)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class ShuffleUnit(Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 1:
+            self.b2 = Sequential(
+                _cb(inp // 2, branch, 1),
+                _cb(branch, branch, 3, stride=1, padding=1, groups=branch,
+                    act=False),
+                _cb(branch, branch, 1))
+        else:
+            self.b1 = Sequential(
+                _cb(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                    act=False),
+                _cb(inp, branch, 1))
+            self.b2 = Sequential(
+                _cb(inp, branch, 1),
+                _cb(branch, branch, 3, stride=stride, padding=1,
+                    groups=branch, act=False),
+                _cb(branch, branch, 1))
+        self.shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat, split
+
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.b2(x2)], axis=1)
+        else:
+            out = concat([self.b1(x), self.b2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = _CFG[f"{scale:.2f}".rstrip("0").rstrip(".")
+                   if f"{scale}" not in _CFG else f"{scale}"]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _cb(3, cfg[0], 3, stride=2, padding=1)
+        self.pool1 = MaxPool2D(3, 2, padding=1)
+        stages = []
+        inp = cfg[0]
+        for idx, repeat in enumerate([4, 8, 4]):
+            oup = cfg[idx + 1]
+            units = [ShuffleUnit(inp, oup, 2)]
+            for _ in range(repeat - 1):
+                units.append(ShuffleUnit(oup, oup, 1))
+            stages.append(Sequential(*units))
+            inp = oup
+        self.stages = Sequential(*stages)
+        self.conv_last = _cb(inp, cfg[-1], 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(cfg[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _make(scale, pretrained, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _make(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _make(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _make(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _make(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _make(2.0, pretrained, **kwargs)

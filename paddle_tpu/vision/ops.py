@@ -1,0 +1,55 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — yolo/roi
+ops + DeformConv; round-1 carries box utilities + nms)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["nms", "box_coder", "RoIAlign", "roi_align", "DeformConv2D"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    b = np.asarray(boxes._value, np.float32)
+    s = (np.asarray(scores._value, np.float32) if scores is not None
+         else np.ones(len(b), np.float32))
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    if top_k is not None:
+        keep = keep[:top_k]
+    return to_tensor(np.asarray(keep, np.int64))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    raise NotImplementedError("box_coder: planned (detection suite)")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    raise NotImplementedError("roi_align: planned (detection suite)")
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        raise NotImplementedError("RoIAlign: planned (detection suite)")
+
+
+class DeformConv2D:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("DeformConv2D: planned (detection suite)")

@@ -1,0 +1,366 @@
+"""paddle.vision.transforms (reference:
+python/paddle/vision/transforms/). numpy/CHW-based implementations."""
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor as _to_tensor
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Normalize", "Transpose",
+    "Resize", "RandomCrop", "CenterCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "RandomResizedCrop", "Pad", "BrightnessTransform",
+    "ContrastTransform", "SaturationTransform", "HueTransform",
+    "ColorJitter", "Grayscale", "RandomRotation", "to_tensor", "normalize",
+    "resize", "hflip", "vflip", "crop", "center_crop", "pad",
+]
+
+
+def _img_array(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+def _hwc(img):
+    arr = _img_array(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, *inputs):
+        if len(inputs) == 1:
+            return self._apply_image(inputs[0])
+        return tuple(self._apply_image(i) for i in inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, *data):
+        for t in self.transforms:
+            data = t(*data) if isinstance(data, tuple) else t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        return arr.transpose(self.order)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if self.padding:
+            arr = pad(arr, self.padding)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if h == th and w == tw:
+            return arr
+        top = _pyrandom.randint(0, max(h - th, 0))
+        left = _pyrandom.randint(0, max(w - tw, 0))
+        return arr[top:top + th, left:left + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _pyrandom.random() < self.prob:
+            return hflip(img)
+        return _hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _pyrandom.random() < self.prob:
+            return vflip(img)
+        return _hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _pyrandom.uniform(*self.scale)
+            ar = _pyrandom.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = _pyrandom.randint(0, h - th)
+                left = _pyrandom.randint(0, w - tw)
+                cropped = arr[top:top + th, left:left + tw]
+                return resize(cropped, self.size, self.interpolation)
+        return resize(center_crop(arr, (min(h, w), min(h, w))), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * f, 0, 255).astype(_hwc(img).dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * f + mean, 0, 255).astype(
+            _hwc(img).dtype)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = arr.mean(axis=2, keepdims=True)
+        return np.clip(gray + (arr - gray) * f, 0, 255).astype(
+            _hwc(img).dtype)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return _hwc(img)  # full HSV hue shift: planned
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+
+    def _apply_image(self, img):
+        out = img
+        for t in self.ts:
+            out = t._apply_image(out)
+        return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        gray = (arr[..., :3] @ np.asarray([0.299, 0.587, 0.114],
+                                          np.float32))[..., None]
+        if self.n == 3:
+            gray = np.repeat(gray, 3, axis=2)
+        return gray.astype(_hwc(img).dtype)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        angle = _pyrandom.uniform(*self.degrees)
+        k = int(round(angle / 90.0)) % 4
+        return np.rot90(arr, k).copy()  # coarse (90° steps); scipy-free
+
+
+# functional variants ----------------------------------------------------
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _hwc(img).astype(np.float32)
+    if arr.dtype == np.uint8 or arr.max() > 2.0:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return _to_tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _img_array(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    if isinstance(img, Tensor):
+        return _to_tensor(out)
+    return out
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _hwc(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    th, tw = size
+    import jax
+    import jax.numpy as jnp
+
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic", "linear": "linear"}.get(interpolation,
+                                                          "linear")
+    out = jax.image.resize(jnp.asarray(arr.astype(np.float32)),
+                           (th, tw, arr.shape[2]), method=method)
+    return np.asarray(out).astype(arr.dtype)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _hwc(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return arr[top:top + th, left:left + tw]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _hwc(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    width = ((top, bottom), (left, right), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(arr, width, mode="constant", constant_values=fill)
+    mode = {"replicate": "edge", "reflect": "reflect",
+            "circular": "wrap"}.get(padding_mode, padding_mode)
+    return np.pad(arr, width, mode=mode)

@@ -1,0 +1,21 @@
+"""Test environment: force an 8-virtual-device CPU platform so
+distributed/sharding tests run without TPU hardware and math checks are
+exact f32 (SURVEY.md §7 / driver contract).
+
+The host image preloads the TPU PJRT plugin via sitecustomize (jax is
+already imported before pytest starts), so JAX_PLATFORMS in the
+environment is too late — use jax.config, which takes effect at first
+backend initialization. Override with PADDLE_TPU_TEST_PLATFORM=axon to
+run the suite against the real chip."""
+import os
+
+_plat = os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", _plat)

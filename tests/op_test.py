@@ -1,0 +1,145 @@
+"""OpTest harness — the analog of the reference's
+python/paddle/fluid/tests/unittests/op_test.py:282.
+
+A test declares `op` (callable from the public API), `inputs` (numpy),
+`attrs`, and expected `outputs`; `check_output` runs the op in (a)
+dygraph eager and (b) to_static/jit mode and compares both against the
+expectation; `check_grad` compares tape-autograd gradients against
+numeric finite differences — exactly the reference's methodology."""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import engine
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest(unittest.TestCase):
+    op = None          # callable
+    inputs = {}        # name -> np array (positional order preserved)
+    attrs = {}         # static kwargs
+    outputs = None     # expected np array or list of arrays
+
+    rtol = 1e-5
+    atol = 1e-6
+    grad_rtol = 1e-2
+    grad_atol = 1e-3
+    grad_eps = 1e-3
+
+    def _tensors(self, stop_gradient=True):
+        return [paddle.to_tensor(v, stop_gradient=stop_gradient)
+                for v in self.inputs.values()]
+
+    def _run_eager(self):
+        return type(self).op(*self._tensors(), **self.attrs)
+
+    def _run_jit(self):
+        import jax
+
+        vals = [np.asarray(v) for v in self.inputs.values()]
+        opfn = type(self).op
+        attrs = self.attrs
+
+        def f(*arrs):
+            with engine.trace_mode():
+                ts = [Tensor(a, stop_gradient=True, _internal=True)
+                      for a in arrs]
+                out = opfn(*ts, **attrs)
+                if isinstance(out, (list, tuple)):
+                    return [o._value for o in out]
+                return out._value
+
+        return jax.jit(f)(*vals)
+
+    def _norm_out(self, out):
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o._value if isinstance(o, Tensor) else o)
+                    for o in out]
+        return [np.asarray(out._value if isinstance(out, Tensor) else out)]
+
+    def check_output(self, check_jit=True):
+        expected = self.outputs
+        if not isinstance(expected, (list, tuple)):
+            expected = [expected]
+        got = self._norm_out(self._run_eager())
+        self.assertEqual(len(got), len(expected),
+                         f"{self.op}: output arity mismatch")
+        for g, e in zip(got, expected):
+            np.testing.assert_allclose(
+                g.astype(np.float64) if g.dtype.kind == "f" else g,
+                np.asarray(e).astype(np.float64)
+                if np.asarray(e).dtype.kind == "f" else np.asarray(e),
+                rtol=self.rtol, atol=self.atol,
+                err_msg=f"eager output mismatch for {self.op}")
+        if check_jit:
+            got_jit = self._norm_out(self._run_jit())
+            for g, e in zip(got_jit, expected):
+                np.testing.assert_allclose(
+                    np.asarray(g, np.float64) if np.asarray(g).dtype.kind == "f"
+                    else np.asarray(g),
+                    np.asarray(e, np.float64)
+                    if np.asarray(e).dtype.kind == "f" else np.asarray(e),
+                    rtol=self.rtol, atol=self.atol,
+                    err_msg=f"jit output mismatch for {self.op}")
+
+    def check_grad(self, inputs_to_check=None, output_index=0):
+        """Analytic (tape) grads vs central finite differences."""
+        names = list(self.inputs.keys())
+        inputs_to_check = inputs_to_check or [
+            n for n in names
+            if np.asarray(self.inputs[n]).dtype.kind == "f"]
+        opfn = type(self).op
+        attrs = self.attrs
+
+        tensors = {n: paddle.to_tensor(self.inputs[n],
+                                       stop_gradient=n not in inputs_to_check)
+                   for n in names}
+        out = opfn(*tensors.values(), **attrs)
+        if isinstance(out, (list, tuple)):
+            out = out[output_index]
+        from paddle_tpu.ops.math import sum as psum
+
+        loss = psum(out)
+        loss.backward()
+
+        for n in inputs_to_check:
+            analytic = np.asarray(tensors[n].grad._value, np.float64)
+            numeric = self._numeric_grad(n, names, output_index)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=self.grad_rtol,
+                atol=self.grad_atol,
+                err_msg=f"gradient mismatch for input {n!r} of {self.op}")
+
+    def _numeric_grad(self, wrt, names, output_index):
+        eps = self.grad_eps
+        base = {n: np.asarray(self.inputs[n], np.float64
+                              if np.asarray(self.inputs[n]).dtype.kind == "f"
+                              else np.asarray(self.inputs[n]).dtype)
+                for n in names}
+        x = base[wrt]
+        grad = np.zeros_like(x, np.float64)
+
+        def eval_sum(xmod):
+            vals = dict(base)
+            vals[wrt] = xmod
+            ts = [paddle.to_tensor(vals[n].astype(
+                np.asarray(self.inputs[n]).dtype)) for n in names]
+            with engine.no_grad():
+                out = type(self).op(*ts, **self.attrs)
+            if isinstance(out, (list, tuple)):
+                out = out[output_index]
+            return float(np.asarray(out._value, np.float64).sum())
+
+        flat = x.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            xp = x.copy().reshape(-1)
+            xm = x.copy().reshape(-1)
+            xp[i] += eps
+            xm[i] -= eps
+            gflat[i] = (eval_sum(xp.reshape(x.shape))
+                        - eval_sum(xm.reshape(x.shape))) / (2 * eps)
+        return grad

@@ -1,0 +1,136 @@
+"""Tape autograd engine tests (reference: imperative BasicEngine tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    z = y + x  # x used twice
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2.0).backward()
+    (x * 3.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_double_backward_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [27.0])
+    assert x.grad is None  # no side effects
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               (np.ones((3, 5)) @ b.numpy().T),
+                               rtol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    ((x + b) * 2.0).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [6.0] * 4)
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2.0
+
+    h = x.register_hook(hook)
+    (x * 3.0).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # doubled by hook
+    h.remove()
+
+
+def test_py_layer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2.0
+
+    x = paddle.to_tensor([5.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [10.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_jacobian_hessian():
+    from paddle_tpu.autograd import jacobian, hessian
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+
+    def f(x):
+        return (x * x).sum()
+
+    h = hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), 2 * np.eye(2), atol=1e-5)

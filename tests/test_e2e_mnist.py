@@ -1,0 +1,67 @@
+"""End-to-end MNIST LeNet (BASELINE config 1: the minimum slice,
+SURVEY §7 step 3)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import DataLoader
+from paddle_tpu.jit import TrainStepCompiler
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_trains_compiled():
+    paddle.seed(0)
+    net = LeNet()
+    ds = MNIST(mode="train")
+    loader = DataLoader(ds, batch_size=64, shuffle=True, drop_last=True)
+    loss_fn = nn.CrossEntropyLoss()
+    o = opt.Adam(learning_rate=1e-3, parameters=net.parameters())
+    step = TrainStepCompiler(net, o,
+                             lambda out, y: loss_fn(out, paddle.squeeze(y, -1)))
+    losses = []
+    for i, (x, y) in enumerate(loader):
+        losses.append(float(step(x, y).item()))
+        if i >= 15:
+            break
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+    assert losses[-1] < 1.5
+
+
+def test_lenet_eval_accuracy_improves():
+    paddle.seed(0)
+    net = LeNet()
+    train = MNIST(mode="train")
+    loader = DataLoader(train, batch_size=128, shuffle=True, drop_last=True)
+    loss_fn = nn.CrossEntropyLoss()
+    o = opt.Adam(learning_rate=2e-3, parameters=net.parameters())
+    step = TrainStepCompiler(net, o,
+                             lambda out, y: loss_fn(out, paddle.squeeze(y, -1)))
+    for i, (x, y) in enumerate(loader):
+        step(x, y)
+        if i >= 12:
+            break
+    net.eval()
+    test = MNIST(mode="train")  # same synthetic distribution
+    x, y = next(iter(DataLoader(test, batch_size=256)))
+    with paddle.no_grad():
+        logits = net(x)
+    pred = np.argmax(logits.numpy(), axis=-1)
+    acc = (pred == y.numpy().reshape(-1)).mean()
+    assert acc > 0.5, f"accuracy too low: {acc}"
+
+
+def test_hapi_model_fit():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    loss_fn = nn.CrossEntropyLoss()
+    model.prepare(opt.Adam(learning_rate=1e-3,
+                           parameters=net.parameters()),
+                  lambda logits, y: loss_fn(logits, paddle.squeeze(y, -1)))
+    ds = MNIST(mode="train")
+    model.fit(ds, batch_size=64, epochs=1, verbose=0, num_iters=10)

@@ -1,0 +1,100 @@
+"""jit/to_static + TrainStepCompiler tests (reference:
+dygraph_to_static test family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import TrainStepCompiler, to_static
+
+
+def test_to_static_function():
+    @to_static
+    def f(x):
+        return x * 2.0 + 1.0
+
+    x = paddle.to_tensor([1.0, 2.0])
+    out = f(x)
+    np.testing.assert_allclose(out.numpy(), [3.0, 5.0])
+    # second call hits the cache
+    out2 = f(paddle.to_tensor([3.0, 4.0]))
+    np.testing.assert_allclose(out2.numpy(), [7.0, 9.0])
+
+
+def test_to_static_layer_method():
+    net = nn.Linear(4, 2)
+    st = to_static(lambda x: net(x))
+    x = paddle.randn([3, 4])
+    eager = net(x).numpy()
+    compiled = st(x).numpy()
+    np.testing.assert_allclose(compiled, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_matches_after_param_update():
+    net = nn.Linear(2, 2)
+    st = to_static(lambda x: net(x))
+    x = paddle.randn([1, 2])
+    _ = st(x)
+    net.weight.set_value(np.zeros((2, 2), np.float32))
+    out = st(x).numpy()
+    np.testing.assert_allclose(out, np.broadcast_to(net.bias.numpy(),
+                                                    (1, 2)), rtol=1e-5)
+
+
+def test_train_step_compiler_convergence():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    loss_fn = nn.MSELoss()
+    o = opt.Adam(learning_rate=0.01, parameters=net.parameters())
+    step = TrainStepCompiler(net, o, lambda out, y: loss_fn(out, y))
+    x = paddle.randn([32, 4])
+    w_true = paddle.randn([4, 1])
+    y = paddle.matmul(x, w_true)
+    losses = [float(step(x, y).item()) for _ in range(80)]
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_train_step_compiler_matches_eager():
+    paddle.seed(3)
+    net_a = nn.Linear(3, 1)
+    net_b = nn.Linear(3, 1)
+    net_b.set_state_dict(net_a.state_dict())
+    loss_fn = nn.MSELoss()
+    x = paddle.randn([8, 3])
+    y = paddle.randn([8, 1])
+
+    oa = opt.SGD(learning_rate=0.1, parameters=net_a.parameters())
+    la = loss_fn(net_a(x), y)
+    la.backward()
+    oa.step()
+
+    ob = opt.SGD(learning_rate=0.1, parameters=net_b.parameters())
+    step = TrainStepCompiler(net_b, ob, lambda out, yy: loss_fn(out, yy))
+    lb = step(x, y)
+    np.testing.assert_allclose(float(la.item()), float(lb.item()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_trace_mode_blocks_numpy():
+    from paddle_tpu.core import engine
+
+    @to_static
+    def f(x):
+        return paddle.to_tensor(x.numpy())  # illegal under trace
+
+    with pytest.raises(Exception):
+        f(paddle.to_tensor([1.0]))
+
+
+def test_jit_save_load(tmp_path):
+    import paddle_tpu.jit as jit
+
+    net = nn.Linear(2, 2)
+    path = str(tmp_path / "model")
+    jit.save(net, path)
+    loaded = jit.load(path)
+    sd = loaded.state_dict()
+    np.testing.assert_allclose(sd["weight"].numpy(), net.weight.numpy())

@@ -1,0 +1,201 @@
+"""nn.Layer system + layers (reference tests: test_layers.py,
+test_imperative_* family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_grad():
+    lin = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = lin(x)
+    assert y.shape == [2, 4]
+    y.sum().backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.shape == [8, 4]
+    assert lin.bias.grad.shape == [4]
+
+
+def test_layer_registry():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    params = net.parameters()
+    assert len(params) == 4
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    subs = net.sublayers()
+    assert len(subs) == 2
+
+
+def test_state_dict_roundtrip():
+    net = nn.Linear(3, 3)
+    sd = net.state_dict()
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_train_eval_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), np.ones(100))
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any()
+    # upscale keeps expectation
+    assert abs(out.mean() - 1.0) < 0.35
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+
+
+def test_conv_bn_pool_stack():
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1),
+        nn.BatchNorm2D(8),
+        nn.ReLU(),
+        nn.MaxPool2D(2, 2),
+    )
+    x = paddle.randn([2, 3, 8, 8])
+    y = net(x)
+    assert y.shape == [2, 8, 4, 4]
+    y.sum().backward()
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(4, momentum=0.5)
+    x = paddle.randn([8, 4, 3, 3]) * 2.0 + 1.0
+    bn.train()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    m = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_allclose(bn._mean.numpy(), m)  # frozen in eval
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.asarray([[1, 2], [3, 4]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16])
+    y = ln(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_losses():
+    ce = nn.CrossEntropyLoss()
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.asarray([0, 1, 2, 3], np.int64))
+    loss = ce(logits, labels)
+    assert loss.shape == []
+    mse = nn.MSELoss()
+    a, b = paddle.randn([3]), paddle.randn([3])
+    np.testing.assert_allclose(mse(a, b).numpy(),
+                               ((a.numpy() - b.numpy()) ** 2).mean(),
+                               rtol=1e-5)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 5, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 5, 16]
+    assert h.shape == [2, 4, 16]
+    out.sum().backward()
+
+
+def test_gru_bidirect():
+    gru = nn.GRU(8, 16, direction="bidirect")
+    x = paddle.randn([2, 5, 8])
+    out, h = gru(x)
+    assert out.shape == [2, 5, 32]
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(
+        lambda lay, inp, out: calls.append(1))
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_clip_grad_by_global_norm():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([8, 4])
+    (lin(x) * 100.0).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = [(p, p.grad) for p in lin.parameters()]
+    clipped = clip(pg)
+    total = sum(float((g.numpy() ** 2).sum()) for _, g in clipped)
+    assert total <= 1.01
+
+
+def test_initializers():
+    from paddle_tpu.nn.initializer import (Constant, KaimingNormal, Normal,
+                                           XavierUniform)
+
+    lin = nn.Linear(100, 50,
+                    weight_attr=paddle.nn.ParamAttr(
+                        initializer=XavierUniform()))
+    w = lin.weight.numpy()
+    limit = np.sqrt(6 / 150)
+    assert np.abs(w).max() <= limit + 1e-6
+    lin2 = nn.Linear(10, 10, weight_attr=paddle.nn.ParamAttr(
+        initializer=Constant(0.5)))
+    np.testing.assert_allclose(lin2.weight.numpy(), np.full((10, 10), 0.5))
+
+
+def test_functional_interpolate():
+    x = paddle.randn([1, 3, 4, 4])
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 3, 8, 8]
+    z = F.interpolate(x, size=[2, 2], mode="bilinear")
+    assert z.shape == [1, 3, 2, 2]

@@ -1,0 +1,312 @@
+"""Op correctness via the OpTest harness (reference: unittests/test_*_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import (activation, conv, linalg, loss_ops,
+                            manipulation, math as pmath, norm_ops)
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+class TestAdd(OpTest):
+    op = staticmethod(pmath.add)
+    inputs = {"x": rng.rand(3, 4).astype(np.float32),
+              "y": rng.rand(3, 4).astype(np.float32)}
+    outputs = inputs["x"] + inputs["y"]
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestMatmul(OpTest):
+    op = staticmethod(linalg.matmul)
+    inputs = {"x": rng.rand(4, 5).astype(np.float32),
+              "y": rng.rand(5, 3).astype(np.float32)}
+    outputs = inputs["x"] @ inputs["y"]
+    rtol = 1e-4
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestMatmulTranspose(OpTest):
+    op = staticmethod(linalg.matmul)
+    inputs = {"x": rng.rand(5, 4).astype(np.float32),
+              "y": rng.rand(5, 3).astype(np.float32)}
+    attrs = {"transpose_x": True}
+    outputs = inputs["x"].T @ inputs["y"]
+    rtol = 1e-4
+
+    def test(self):
+        self.check_output()
+
+
+class TestExp(OpTest):
+    op = staticmethod(pmath.exp)
+    inputs = {"x": rng.rand(10).astype(np.float32)}
+    outputs = np.exp(inputs["x"])
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestSoftmax(OpTest):
+    op = staticmethod(activation.softmax)
+    inputs = {"x": rng.rand(4, 8).astype(np.float32)}
+    x = inputs["x"]
+    e = np.exp(x - x.max(-1, keepdims=True))
+    outputs = e / e.sum(-1, keepdims=True)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestMeanAxis(OpTest):
+    op = staticmethod(pmath.mean)
+    inputs = {"x": rng.rand(3, 4, 5).astype(np.float32)}
+    attrs = {"axis": 1, "keepdim": True}
+    outputs = inputs["x"].mean(1, keepdims=True)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestReshapeTranspose(OpTest):
+    op = staticmethod(manipulation.reshape)
+    inputs = {"x": rng.rand(2, 6).astype(np.float32)}
+    attrs = {"shape": [3, 4]}
+    outputs = inputs["x"].reshape(3, 4)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestConcat(OpTest):
+    @staticmethod
+    def op(x, y, **kw):
+        return manipulation.concat([x, y], **kw)
+
+    inputs = {"x": rng.rand(2, 3).astype(np.float32),
+              "y": rng.rand(2, 3).astype(np.float32)}
+    attrs = {"axis": 1}
+    outputs = np.concatenate([inputs["x"], inputs["y"]], axis=1)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestLayerNorm(OpTest):
+    @staticmethod
+    def op(x, w, b, **kw):
+        return norm_ops.layer_norm(x, [8], w, b)
+
+    inputs = {"x": rng.rand(4, 8).astype(np.float32),
+              "w": np.ones(8, np.float32),
+              "b": np.zeros(8, np.float32)}
+    x = inputs["x"]
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    outputs = (x - mu) / np.sqrt(var + 1e-5)
+    rtol = 1e-4
+    atol = 1e-5
+
+    def test(self):
+        self.check_output()
+        self.check_grad(inputs_to_check=["x"])
+
+
+class TestCrossEntropy(OpTest):
+    @staticmethod
+    def op(logits, label, **kw):
+        return loss_ops.cross_entropy(logits, label)
+
+    logits = rng.rand(6, 10).astype(np.float32)
+    label = rng.randint(0, 10, (6,)).astype(np.int64)
+    inputs = {"logits": logits, "label": label}
+    lsm = logits - logits.max(-1, keepdims=True)
+    lsm = lsm - np.log(np.exp(lsm).sum(-1, keepdims=True))
+    outputs = np.float32(-lsm[np.arange(6), label].mean())
+    rtol = 1e-4
+    atol = 1e-5
+
+    def test(self):
+        self.check_output()
+        self.check_grad(inputs_to_check=["logits"])
+
+
+class TestConv2D(OpTest):
+    @staticmethod
+    def op(x, w, **kw):
+        return conv.conv2d(x, w, **kw)
+
+    inputs = {"x": rng.rand(1, 1, 5, 5).astype(np.float32),
+              "w": rng.rand(2, 1, 3, 3).astype(np.float32)}
+    attrs = {"padding": 1}
+    # reference computed with scipy-style direct conv
+    x, w = inputs["x"], inputs["w"]
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    out = np.zeros((1, 2, 5, 5), np.float32)
+    for oc in range(2):
+        for i in range(5):
+            for j in range(5):
+                out[0, oc, i, j] = (xp[0, 0, i:i + 3, j:j + 3]
+                                    * w[oc, 0]).sum()
+    outputs = out
+    rtol = 1e-4
+    atol = 1e-4
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestTopK(OpTest):
+    @staticmethod
+    def op(x, **kw):
+        return paddle.topk(x, **kw)
+
+    inputs = {"x": np.asarray([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]],
+                              np.float32)}
+    attrs = {"k": 2}
+    outputs = [np.asarray([[3.0, 2.0], [5.0, 4.0]], np.float32),
+               np.asarray([[0, 2], [1, 2]], np.int64)]
+
+    def test(self):
+        self.check_output()
+
+
+class TestWhere(OpTest):
+    @staticmethod
+    def op(c, x, y, **kw):
+        return manipulation.where(c, x, y)
+
+    inputs = {"c": np.asarray([True, False, True]),
+              "x": np.asarray([1.0, 2.0, 3.0], np.float32),
+              "y": np.asarray([9.0, 8.0, 7.0], np.float32)}
+    outputs = np.asarray([1.0, 8.0, 3.0], np.float32)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(inputs_to_check=["x", "y"])
+
+
+class TestGather(OpTest):
+    @staticmethod
+    def op(x, idx, **kw):
+        return manipulation.gather(x, idx)
+
+    inputs = {"x": rng.rand(5, 3).astype(np.float32),
+              "idx": np.asarray([0, 2, 4], np.int64)}
+    outputs = inputs["x"][[0, 2, 4]]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(inputs_to_check=["x"])
+
+
+class TestCumsum(OpTest):
+    op = staticmethod(pmath.cumsum)
+    inputs = {"x": rng.rand(3, 4).astype(np.float32)}
+    attrs = {"axis": 1}
+    outputs = np.cumsum(inputs["x"], axis=1)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestPad(OpTest):
+    op = staticmethod(manipulation.pad)
+    inputs = {"x": rng.rand(1, 1, 3, 3).astype(np.float32)}
+    attrs = {"pad": [1, 1, 2, 2]}
+    outputs = np.pad(inputs["x"], ((0, 0), (0, 0), (2, 2), (1, 1)))
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestBatchNormInfer(OpTest):
+    @staticmethod
+    def op(x, m, v, w, b, **kw):
+        out, _, _ = norm_ops.batch_norm(x, m, v, w, b, training=False)
+        return out
+
+    inputs = {"x": rng.rand(4, 3, 2, 2).astype(np.float32),
+              "m": np.zeros(3, np.float32),
+              "v": np.ones(3, np.float32),
+              "w": np.ones(3, np.float32),
+              "b": np.zeros(3, np.float32)}
+    outputs = (inputs["x"] / np.sqrt(1 + 1e-5))
+    rtol = 1e-4
+    atol = 1e-5
+
+    def test(self):
+        self.check_output()
+
+
+def test_einsum():
+    a = paddle.to_tensor(rng.rand(2, 3).astype(np.float32))
+    b = paddle.to_tensor(rng.rand(3, 4).astype(np.float32))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
+
+
+def test_split_stack_unstack():
+    x = paddle.to_tensor(rng.rand(6, 4).astype(np.float32))
+    parts = paddle.split(x, 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    st = paddle.stack(parts, axis=0)
+    assert st.shape == [3, 2, 4]
+    us = paddle.unstack(st, axis=0)
+    assert len(us) == 3
+
+
+def test_sort_argsort():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]])
+    s = paddle.sort(x, axis=-1)
+    np.testing.assert_allclose(s.numpy(), [[1, 2, 3]])
+    idx = paddle.argsort(x, axis=-1, descending=True)
+    np.testing.assert_array_equal(idx.numpy(), [[0, 2, 1]])
+
+
+def test_linalg_family():
+    a_np = rng.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    a = paddle.to_tensor(a_np)
+    inv = paddle.linalg.inv(a) if hasattr(paddle, "linalg") else None
+    from paddle_tpu.ops import linalg as L
+
+    np.testing.assert_allclose(L.inv(a).numpy() @ a_np, np.eye(3),
+                               atol=1e-4)
+    np.testing.assert_allclose(float(L.det(a).item()),
+                               float(np.linalg.det(a_np)), rtol=1e-4)
+    u, s, vt = L.svd(a)
+    np.testing.assert_allclose(
+        (u.numpy() * s.numpy()) @ vt.numpy(), a_np, atol=1e-4)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_reduce_family():
+    x = paddle.to_tensor(rng.rand(3, 4).astype(np.float32))
+    assert paddle.max(x).numpy() == x.numpy().max()
+    np.testing.assert_allclose(paddle.logsumexp(x, axis=1).numpy(),
+                               np.log(np.exp(x.numpy()).sum(1)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.std(x).numpy(),
+                               x.numpy().std(ddof=1), rtol=1e-4)

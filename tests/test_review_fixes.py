@@ -1,0 +1,110 @@
+"""Regression tests for review findings (round 1 code review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+
+
+def test_adamw_apply_decay_param_fun_per_param():
+    wa = paddle.to_tensor([1.0], stop_gradient=False)
+    wa.name = "layer.weight"
+    wb = paddle.to_tensor([1.0], stop_gradient=False)
+    wb.name = "layer.bias"
+    o = optim.AdamW(learning_rate=0.1, parameters=[wa, wb],
+                    weight_decay=0.5,
+                    apply_decay_param_fun=lambda n: "bias" not in n)
+    (wa * 0.0 + wb * 0.0).sum().backward()
+    o.step()
+    # weight decayed, bias NOT decayed
+    np.testing.assert_allclose(wa.numpy(), [1.0 * (1 - 0.05)], rtol=1e-6)
+    np.testing.assert_allclose(wb.numpy(), [1.0], rtol=1e-6)
+
+
+def test_grad_scaler_no_double_unscale():
+    from paddle_tpu.amp import GradScaler
+
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w_scaler"
+    o = optim.SGD(learning_rate=1.0, parameters=[w])
+    scaler = GradScaler(init_loss_scaling=4.0)
+    loss = (w * 3.0).sum()
+    scaler.scale(loss).backward()  # grad = 3*4 = 12
+    scaler.unscale_(o)             # -> 3
+    np.testing.assert_allclose(w.grad.numpy(), [3.0], rtol=1e-6)
+    scaler.step(o)                 # must NOT unscale again
+    np.testing.assert_allclose(w.numpy(), [1.0 - 3.0], rtol=1e-6)
+
+
+def test_cross_entropy_negative_ignore_index():
+    logits = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32))
+    label = paddle.to_tensor(np.asarray([1, -1, 2, -1], np.int64))
+    loss = F.cross_entropy(logits, label, ignore_index=-1)
+    # only rows 0 and 2 count
+    ref_rows = []
+    lg = logits.numpy()
+    for i, l in enumerate([1, -1, 2, -1]):
+        if l == -1:
+            continue
+        lsm = lg[i] - lg[i].max()
+        lsm = lsm - np.log(np.exp(lsm).sum())
+        ref_rows.append(-lsm[l])
+    np.testing.assert_allclose(float(loss.item()), np.mean(ref_rows),
+                               rtol=1e-5)
+
+
+def test_cross_entropy_prob_mode_weight_and_ignore():
+    probs = paddle.to_tensor(np.full((3, 4), 0.25, np.float32))
+    label = paddle.to_tensor(np.asarray([0, 1, -1], np.int64))
+    w = paddle.to_tensor(np.asarray([2.0, 1.0, 1.0, 1.0], np.float32))
+    loss = F.cross_entropy(probs, label, weight=w, ignore_index=-1,
+                           use_softmax=False)
+    # rows: -log(.25)*2 (w=2), -log(.25)*1; ignored row dropped
+    expect = (2 * -np.log(0.25) + 1 * -np.log(0.25)) / 3.0
+    np.testing.assert_allclose(float(loss.item()), expect, rtol=1e-5)
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    t = x * 1.0
+    calls = []
+    t.register_hook(lambda g: calls.append(g.numpy().copy()) or
+                    g.clip(-1.0, 1.0))
+    y = t.sum() + (t * 2.0).sum()  # two consumers: accumulated grad 3
+    y.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])  # clipped once
+
+
+def test_cummax_returns_values_and_indices():
+    x = paddle.to_tensor(np.asarray([[1.0, 3.0, 2.0, 3.0]], np.float32))
+    v, i = paddle.cummax(x, axis=1)
+    np.testing.assert_allclose(v.numpy(), [[1, 3, 3, 3]])
+    np.testing.assert_array_equal(i.numpy(), [[0, 1, 1, 1]])
+    v2, i2 = paddle.cummin(x, axis=1)
+    np.testing.assert_allclose(v2.numpy(), [[1, 1, 1, 1]])
+    np.testing.assert_array_equal(i2.numpy(), [[0, 0, 0, 0]])
+
+
+def test_grad_raises_on_unused_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    with pytest.raises(ValueError):
+        paddle.grad(y, [z])
+    y2 = (x * x).sum()  # the first grad() consumed y's tape
+    gs = paddle.grad(y2, [z], allow_unused=True)
+    assert gs[0] is None
+
+
+def test_jit_cache_bounded():
+    from paddle_tpu.core import engine
+
+    before = len(engine._jit_cache)
+    x = paddle.to_tensor([1.0])
+    for s in range(600):
+        paddle.scale(x, scale=float(s))
+    assert len(engine._jit_cache) <= engine._JIT_CACHE_MAX

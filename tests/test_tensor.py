@@ -1,0 +1,104 @@
+"""Tensor basics (reference tests: unittests/test_var_base.py style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_to_tensor_roundtrip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert t.dtype == paddle.float32
+    np.testing.assert_array_equal(t.numpy(), a)
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3], dtype="int32")
+    f = t.astype("float32")
+    assert f.dtype == paddle.float32
+    assert t.dtype == paddle.int32
+
+
+def test_default_float64_downcast():
+    t = paddle.to_tensor(np.zeros(3))  # float64 numpy
+    assert t.dtype == paddle.float32
+
+
+def test_arith_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((1.0 - a).numpy(), [0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+
+def test_matmul_operator():
+    a = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    b = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose((a @ b).numpy(), b.numpy())
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    row = t[1]
+    np.testing.assert_allclose(row.numpy(), [4, 5, 6, 7])
+    sub = t[0:2, 1:3]
+    assert sub.shape == [2, 2]
+    t[0, 0] = 99.0
+    assert float(t[0, 0].item()) == 99.0
+    # tensor fancy index
+    idx = paddle.to_tensor([0, 2])
+    picked = t[idx]
+    assert picked.shape == [2, 4]
+
+
+def test_item_and_scalars():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert int(paddle.to_tensor(7)) == 7
+
+
+def test_detach_and_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert c.shape == [1]
+
+
+def test_set_value():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.set_value(np.asarray([5.0, 6.0], np.float32))
+    np.testing.assert_allclose(t.numpy(), [5, 6])
+
+
+def test_shape_props():
+    t = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+    assert t.ndim == 3
+    assert t.numel() == 24
+    assert len(t) == 2
+    assert t.T.shape == [4, 3, 2]
+
+
+def test_save_load(tmp_path):
+    path = str(tmp_path / "ckpt.pdparams")
+    obj = {"w": paddle.to_tensor([1.0, 2.0]), "step": 3}
+    paddle.save(obj, path)
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1, 2])
+    assert loaded["step"] == 3
