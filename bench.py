@@ -1,0 +1,89 @@
+"""Benchmark entry (driver contract): prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Flagship metric (BASELINE.md): GPT-2 345M training throughput,
+tokens/sec/chip, full train step (fwd+bwd+AdamW) compiled via
+TrainStepCompiler, bf16 weights/activations on the MXU.
+
+vs_baseline: ratio against the reference stack's nominal V100 number
+for Megatron-style GPT-2 345M fp16 training (~12k tokens/s/GPU) —
+BASELINE.md records no published numbers, so this constant is the
+documented stand-in for "CUDAPlace/V100 step time" (north star: ≥1/1.2
+≈ 0.83 of it).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_GPT2_345M_TOKENS_PER_SEC = 12000.0
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, ffn_hidden=4096, max_seq_len=1024,
+                        dropout=0.0, remat=True, use_flash_attention=True)
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # CPU smoke (driver always runs on TPU; this keeps it runnable)
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, ffn_hidden=256, max_seq_len=128,
+                        dropout=0.0, remat=False, use_flash_attention=False)
+        batch, seq, steps, warmup = 4, 128, 5, 1
+
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        # bf16 weights: MXU-native (reference analog: pure-fp16 O2)
+        import jax.numpy as jnp
+
+        for _, p in model.named_parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      weight_decay=0.01)
+    step = TrainStepCompiler(model, opt, loss_fn=None)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                          (batch, seq)).astype(np.int32))
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    loss.numpy()  # sync
+    dt = (time.perf_counter() - t0) / steps
+    tokens_per_sec = batch * seq / dt
+
+    out = {
+        "metric": "gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt2_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        # the V100 ratio only makes sense for the real 345M TPU run;
+        # the CPU smoke is a different workload entirely
+        "vs_baseline": (round(tokens_per_sec
+                              / V100_GPT2_345M_TOKENS_PER_SEC, 4)
+                        if on_tpu else 0.0),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -275,15 +275,27 @@ class TrainStepCompiler:
         frozen = {k: p for k, p in params.items() if not p.trainable}
         return trainable, frozen, bufs
 
+    # -- placement hooks (overridden by DistributedTrainStepCompiler) --
+    def _prepare_call(self, trainable, frozen, bufs):
+        pass
+
+    def _place_batch(self, batch):
+        return tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+
+    def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
     def __call__(self, *batch):
         trainable, frozen, bufs = self._params_and_buffers()
+        self._prepare_call(trainable, frozen, bufs)
         if self._compiled is None:
             self._build(trainable, frozen, bufs, batch)
         pvals = {k: p._value for k, p in trainable.items()}
         fvals = {k: p._value for k, p in frozen.items()}
         bvals = {k: b._value for k, b in bufs.items()}
-        avals = tuple(b._value if isinstance(b, Tensor) else b
-                      for b in batch)
+        avals = self._place_batch(batch)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         rngc = jnp.asarray(self._step, jnp.uint32)
         new_p, new_opt, new_b, loss = self._compiled(
@@ -295,9 +307,11 @@ class TrainStepCompiler:
             b._value = new_b[k]
         self._step += 1
         self._opt._step_count += 1
-        from ..optimizer.lr import LRScheduler
-
         return Tensor(loss, stop_gradient=True, _internal=True)
+
+    def _init_opt_state(self, t_items):
+        self._opt_state = self._opt.init_state(
+            {k: p._value for k, p in t_items})
 
     def _build(self, trainable, frozen, bufs, batch):
         model = self._model
@@ -306,8 +320,7 @@ class TrainStepCompiler:
         t_items = list(trainable.items())
         f_items = list(frozen.items())
         b_items = list(bufs.items())
-        self._opt_state = opt.init_state(
-            {k: p._value for k, p in t_items})
+        self._init_opt_state(t_items)
 
         def loss_of(pvals, fvals, bvals, avals, rngc):
             with engine.trace_mode():
@@ -353,5 +366,5 @@ class TrainStepCompiler:
             new_p, new_s = opt.apply_gradients(pvals, grads, opt_state, lr)
             return new_p, new_s, new_bvals, loss
 
-        donate = (0, 1) if self._donate else ()
-        self._compiled = jax.jit(step_fn, donate_argnums=donate)
+        self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
+                                        batch)

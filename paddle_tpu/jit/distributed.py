@@ -1,0 +1,131 @@
+"""Distributed (multi-chip) train-step compilation.
+
+Parity target: the reference's whole distributed execution stack —
+fleet meta-optimizers rewriting programs with c_allreduce/c_broadcast
+ops + ParallelExecutor NCCL handles (raw_program_optimizer.py,
+details/all_reduce_op_handle.cc).
+
+TPU-native design: ONE pjit'd train step over the global Mesh,
+subclassing TrainStepCompiler (same loss/step construction) and
+overriding only placement:
+- every Parameter carries `dist_spec` (PartitionSpec) — set by the
+  Megatron TP layers, group_sharded (ZeRO), the GPT stacked-layer
+  model ('pp' on the layer dim), or None (replicated).
+- the batch is sharded over 'dp' (and 'sp' for sequence parallelism).
+- optimizer slot states inherit the parameter's sharding (ZeRO-ish by
+  construction when 'sharding' specs are set).
+- XLA/GSPMD derives ALL collectives (gradient all-reduce over dp,
+  Megatron all-reduces over mp, layer-pipeline collective-permutes
+  over pp, sequence all-gathers over sp) and schedules them on ICI —
+  replacing every c_* op and NCCL ring of the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import TrainStepCompiler
+
+__all__ = ["DistributedTrainStepCompiler", "filter_spec"]
+
+
+def filter_spec(spec, mesh):
+    """Drop axis names the mesh doesn't have (pp=1 runs etc.)."""
+    if spec is None:
+        return P()
+    names = []
+    for a in spec:
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in mesh.shape)
+            names.append(kept if kept else None)
+        else:
+            names.append(a if (a is None or a in mesh.shape) else None)
+    return P(*names)
+
+
+class DistributedTrainStepCompiler(TrainStepCompiler):
+    """pjit'd train step over a Mesh with dist_spec-driven shardings.
+
+    usage:
+        mesh = paddle_tpu.distributed.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+        step = DistributedTrainStepCompiler(model, opt, loss_fn, mesh,
+                                            batch_specs=[P("dp"), P("dp")])
+        loss = step(input_ids, labels)
+    """
+
+    def __init__(self, model, optimizer, loss_fn=None, mesh=None,
+                 batch_specs=None, donate=True):
+        from ..distributed import mesh as mesh_mod
+
+        super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate)
+        self._mesh = mesh or mesh_mod.default_mesh()
+        mesh_mod.set_mesh(self._mesh)  # activation constraints read this
+        self._batch_specs = batch_specs
+        self._sharded_params = False
+        self._slot_shardings = None
+
+    def _param_sharding(self, p):
+        return NamedSharding(self._mesh,
+                             filter_spec(getattr(p, "dist_spec", None),
+                                         self._mesh))
+
+    def _batch_sharding(self, i, ndim):
+        spec = (self._batch_specs[i] if self._batch_specs is not None
+                else P(*(("dp",) + (None,) * (ndim - 1))))
+        return NamedSharding(self._mesh, filter_spec(spec, self._mesh))
+
+    # -- hook overrides ---------------------------------------------------
+    def _prepare_call(self, trainable, frozen, bufs):
+        if self._sharded_params:
+            return
+        # place parameter arrays per dist_spec (c_broadcast-at-startup
+        # analog — a single device_put onto the mesh)
+        for coll in (trainable, frozen, bufs):
+            for p in coll.values():
+                p._value = jax.device_put(p._value, self._param_sharding(p))
+        self._sharded_params = True
+
+    def _place_batch(self, batch):
+        out = []
+        for i, b in enumerate(batch):
+            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            out.append(jax.device_put(v, self._batch_sharding(i, v.ndim)))
+        return tuple(out)
+
+    def _init_opt_state(self, t_items):
+        super()._init_opt_state(t_items)
+        # shard optimizer slots like their parameters (ZeRO pattern when
+        # 'sharding' specs are present)
+        self._slot_shardings = {}
+        repl = NamedSharding(self._mesh, P())
+        for k, p in t_items:
+            psh = self._param_sharding(p)
+            slots = {}
+            for sname, sval in self._opt_state[k].items():
+                same_shape = tuple(np.shape(sval)) == tuple(p._value.shape)
+                sh = psh if same_shape else repl
+                slots[sname] = sh
+                self._opt_state[k][sname] = jax.device_put(sval, sh)
+            self._slot_shardings[k] = slots
+
+    def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
+        mesh = self._mesh
+        repl = NamedSharding(mesh, P())
+        param_sh = {k: self._param_sharding(p)
+                    for k, p in trainable.items()}
+        frozen_sh = {k: self._param_sharding(p)
+                     for k, p in frozen.items()}
+        buf_sh = {k: repl for k in bufs}
+        batch_sh = []
+        for i, b in enumerate(batch):
+            v = b._value if isinstance(b, Tensor) else np.asarray(b)
+            batch_sh.append(self._batch_sharding(i, np.ndim(v)))
+        in_shardings = (param_sh, self._slot_shardings, frozen_sh, buf_sh,
+                        tuple(batch_sh), repl, repl)
+        out_shardings = (param_sh, self._slot_shardings, buf_sh, repl)
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)

@@ -1,0 +1,6 @@
+"""paddle_tpu.text.models — language model zoo (reference capability:
+PaddleNLP-style GPT/BERT/ERNIE driven through fleet; here built-in
+since the benchmark ladder needs them: BASELINE configs 3-5)."""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_345m
+from .bert import BertConfig, BertModel, BertForPretraining, bert_base
+from .ernie import ErnieConfig, ErnieModel, ErnieForPretraining

@@ -1,0 +1,122 @@
+"""BERT (BASELINE config 3: BERT-base pretrain, fused attention +
+layer_norm path). Built from the fused transformer blocks
+(incubate.nn.FusedTransformerEncoderLayer ≙ reference
+fused_attention/fused_feedforward CUDA ops)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...incubate.nn import FusedTransformerEncoderLayer
+from ...nn import (Dropout, Embedding, Layer, LayerList, LayerNorm, Linear,
+                   Tanh)
+from ...nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining", "bert_base"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_seq_len, c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from ...ops.creation import arange, zeros_like
+        from ...ops.manipulation import unsqueeze
+
+        seq = input_ids.shape[1]
+        pos = arange(seq, dtype="int64")
+        pos = unsqueeze(pos, 0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(pos)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([
+            FusedTransformerEncoderLayer(
+                config.hidden_size, config.num_heads, config.ffn_hidden,
+                dropout_rate=config.dropout, activation="gelu")
+            for _ in range(config.num_layers)
+        ])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            from ...ops.manipulation import reshape
+
+            b, s = attention_mask.shape[0], attention_mask.shape[-1]
+            m = reshape(attention_mask, [b, 1, 1, s])
+            mask = (1.0 - m.astype("float32")) * -1e4
+        for lay in self.encoder:
+            x = lay(x, src_mask=mask)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference pretraining objective)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.config = config
+        self.mlm_transform = Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = LayerNorm(config.hidden_size)
+        self.mlm_bias = self.create_parameter([config.vocab_size],
+                                              is_bias=True)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq_out)))
+        # decoder tied to word embeddings
+        wte = self.bert.embeddings.word_embeddings.weight
+        from ...ops.linalg import matmul
+
+        logits = matmul(h, wte, transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        mlm_loss = F.cross_entropy(logits, masked_lm_labels,
+                                   ignore_index=-1)
+        loss = mlm_loss
+        if next_sentence_label is not None:
+            loss = loss + F.cross_entropy(nsp_logits, next_sentence_label)
+        return loss
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)

@@ -1,0 +1,131 @@
+"""ERNIE (BASELINE config 5: hybrid-parallel mp+pp pretrain).
+
+ERNIE's architecture is BERT-family; what config 5 exercises is the
+HYBRID wiring: Megatron TP layers (ColumnParallel/RowParallel/
+VocabParallelEmbedding) inside a PipelineLayer segmentation. This model
+is built exactly that way so fleet.distributed_model picks the
+pipeline/tensor wrappers (reference:
+hybrid_parallel_pp_transformer.py test family)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, LayerDesc, ParallelCrossEntropy, PipelineLayer,
+    RowParallelLinear, VocabParallelEmbedding)
+from ...nn import Dropout, Layer, LayerNorm
+from ...nn import functional as F
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 3072
+    max_seq_len: int = 512
+    dropout: float = 0.1
+    num_stages: int = 1
+
+
+class ErnieEmbedding(Layer):
+    def __init__(self, c: ErnieConfig):
+        super().__init__()
+        self.word_emb = VocabParallelEmbedding(c.vocab_size, c.hidden_size)
+        self.pos_emb = VocabParallelEmbedding(c.max_seq_len, c.hidden_size)
+        self.norm = LayerNorm(c.hidden_size)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, input_ids):
+        from ...ops.creation import arange
+        from ...ops.manipulation import unsqueeze
+
+        pos = unsqueeze(arange(input_ids.shape[1], dtype="int64"), 0)
+        return self.dropout(self.norm(self.word_emb(input_ids)
+                                      + self.pos_emb(pos)))
+
+
+class ErnieBlock(Layer):
+    """TP transformer block: column-parallel QKV/FC1, row-parallel
+    proj/FC2 — the Megatron split from mp_layers.py."""
+
+    def __init__(self, c: ErnieConfig):
+        super().__init__()
+        h = c.hidden_size
+        self.ln1 = LayerNorm(h)
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+        self.ln2 = LayerNorm(h)
+        self.fc1 = ColumnParallelLinear(h, c.ffn_hidden,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(c.ffn_hidden, h,
+                                     input_is_parallel=True)
+        self.n_head = c.num_heads
+        self.dropout = c.dropout
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape, transpose, split
+
+        residual = x
+        h = self.ln1(x)
+        qkv = self.qkv(h)
+        b, s = qkv.shape[0], qkv.shape[1]
+        q, k, v = split(qkv, 3, axis=2)
+
+        def heads(t):
+            return transpose(reshape(t, [b, s, self.n_head, -1]),
+                             [0, 2, 1, 3])
+
+        q, k, v = heads(q), heads(k), heads(v)
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=False,
+                                              training=self.training)
+        attn = reshape(transpose(attn, [0, 2, 1, 3]), [b, s, -1])
+        x = residual + self.proj(attn)
+        residual = x
+        h = self.ln2(x)
+        x = residual + self.fc2(F.gelu(self.fc1(h)))
+        return x
+
+
+class ErnieHead(Layer):
+    def __init__(self, c: ErnieConfig):
+        super().__init__()
+        self.norm = LayerNorm(c.hidden_size)
+        self.out = ColumnParallelLinear(c.hidden_size, c.vocab_size,
+                                        gather_output=True)
+
+    def forward(self, x):
+        return self.out(self.norm(x))
+
+
+class ErnieModel(PipelineLayer):
+    """Pipeline-segmented ERNIE: embedding | blocks... | head."""
+
+    def __init__(self, config: ErnieConfig, topology=None):
+        self.config = config
+        descs = [LayerDesc(ErnieEmbedding, config)]
+        descs += [LayerDesc(ErnieBlock, config)
+                  for _ in range(config.num_layers)]
+        descs += [LayerDesc(ErnieHead, config)]
+        loss = ParallelCrossEntropy()
+        super().__init__(descs, num_stages=config.num_stages,
+                         topology=topology,
+                         loss_fn=lambda logits, label: loss(logits, label))
+
+
+class ErnieForPretraining(Layer):
+    def __init__(self, config: ErnieConfig, topology=None):
+        super().__init__()
+        self.ernie = ErnieModel(config, topology)
+        self.loss = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None):
+        logits = self.ernie(input_ids)
+        if labels is None:
+            return logits
+        from ...ops.math import mean
+
+        return mean(self.loss(logits, labels))

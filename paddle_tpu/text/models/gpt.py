@@ -1,0 +1,276 @@
+"""GPT-2 — the flagship model (BASELINE config 4: GPT-2 345M Fleet DP).
+
+Reference capability: PaddleNLP GPT trained through fleet hybrid
+parallelism (the reference repo itself carries the primitives:
+mp_layers.py, pp_layers.py, fused_attention).
+
+TPU-native design decisions:
+- The L transformer blocks are ONE set of stacked parameters with a
+  leading layer dim, executed with `lax.scan` — XLA compiles one block
+  and reuses it L times (fast compiles, and the 'pp' mesh axis shards
+  the layer dim: scan + GSPMD resharding = a layer-pipeline over ICI).
+- Attention uses the Pallas flash kernel on TPU (xla fallback).
+- Every activation carries sharding constraints over (dp, sp, mp) so
+  pjit lowers to Megatron-style comm without hand-written collectives.
+- The LM head is tied to the (vocab-sharded) embedding; the softmax CE
+  over the sharded vocab axis is the ParallelCrossEntropy pattern.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.engine import apply_op, in_trace_mode
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer.layers import Layer
+from ...ops import random as _random
+from ...distributed import mesh as mesh_mod
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_small",
+           "gpt2_345m"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden: int = 4096
+    max_seq_len: int = 1024
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    remat: bool = True  # jax.checkpoint each block (recompute analog)
+
+
+def _maybe_constrain(x, spec):
+    """Sharding constraint when compiling over a mesh (no-op eager)."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        return x
+    names = tuple(a if (a is None or a in mesh.shape) else None
+                  for a in spec)
+    if all(n is None for n in names):
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, P(*names)))
+    except (ValueError, TypeError):
+        return x
+
+
+def _attention(q, k, v, n_head, use_flash):
+    b, s, h = q.shape
+    d = h // n_head
+    q = q.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
+    scale = 1.0 / math.sqrt(d)
+    if use_flash:
+        try:
+            from ...incubate.nn.attention_pallas import _flash_fwd_impl  # noqa
+            from ...incubate.nn.attention_pallas import flash_attention
+
+            dev = jax.devices()[0].platform
+            if dev in ("tpu", "axon") and s % 128 == 0 and d in (64, 128):
+                out = flash_attention(q, k, v, True, scale)
+                return out.transpose(0, 2, 1, 3).reshape(b, s, h)
+        except Exception:
+            pass
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return out.transpose(0, 2, 1, 3).reshape(b, s, h)
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _dropout(x, rate, key):
+    if key is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _block(x, bp, key, n_head, eps, use_flash, dropout):
+    """One transformer block; bp holds this layer's parameter slices."""
+    k1 = k2 = None
+    if key is not None and dropout > 0.0:
+        k1, k2 = jax.random.split(key)
+    h = _layer_norm(x, bp["ln1_w"], bp["ln1_b"], eps)
+    qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+    qkv = _maybe_constrain(qkv, ("dp", "sp", "mp"))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = _attention(q, k, v, n_head, use_flash)
+    attn = attn @ bp["proj_w"] + bp["proj_b"]
+    attn = _dropout(attn, dropout, k1)
+    x = x + _maybe_constrain(attn, ("dp", "sp", None))
+    h = _layer_norm(x, bp["ln2_w"], bp["ln2_b"], eps)
+    ffn = h @ bp["fc1_w"] + bp["fc1_b"]
+    ffn = jax.nn.gelu(_maybe_constrain(ffn, ("dp", "sp", "mp")))
+    ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]
+    ffn = _dropout(ffn, dropout, k2)
+    x = x + _maybe_constrain(ffn, ("dp", "sp", None))
+    return x
+
+
+def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
+                   dropout=0.0, key=None):
+    x = jnp.take(params["wte"], ids, axis=0)
+    pos = jnp.arange(ids.shape[1])
+    x = x + jnp.take(params["wpe"], pos, axis=0)
+    x = _dropout(x, dropout, key)
+    x = _maybe_constrain(x, ("dp", "sp", None))
+
+    blocks = params["blocks"]
+    n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    layer_keys = (jax.random.split(jax.random.fold_in(key, 1), n_layers)
+                  if key is not None and dropout > 0.0 else None)
+
+    def scan_body(carry, xs):
+        layer_params, lkey = xs
+        fn = _block
+        if remat:
+            fn = jax.checkpoint(
+                lambda c, lp, lk: _block(c, lp, lk, n_head, eps, use_flash,
+                                         dropout))
+            out = fn(carry, layer_params, lkey)
+        else:
+            out = _block(carry, layer_params, lkey, n_head, eps, use_flash,
+                         dropout)
+        return out, None
+
+    if layer_keys is not None:
+        x, _ = jax.lax.scan(scan_body, x, (blocks, layer_keys))
+    else:
+        x, _ = jax.lax.scan(lambda c, lp: scan_body(c, (lp, None)), x,
+                            blocks)
+    x = _layer_norm(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = x @ params["wte"].T  # tied head; vocab-sharded over mp
+    logits = _maybe_constrain(logits, ("dp", "sp", "mp"))
+    return logits
+
+
+def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
+                dropout=0.0, key=None):
+    """Causal-LM loss with the standard next-token shift: position t
+    predicts labels[t+1] (HF convention — pass labels=input_ids)."""
+    logits = _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
+                            dropout, key)
+    lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = labels[:, 1:]
+    picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+class GPTModel(Layer):
+    """Decoder-only transformer with stacked-layer parameters."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        key = _random.next_key()
+        ks = jax.random.split(key, 12)
+        std = c.initializer_range
+
+        def normal(k, shape):
+            return std * jax.random.normal(k, shape, dtype=jnp.float32)
+
+        L, H, F, V, S = (c.num_layers, c.hidden_size, c.ffn_hidden,
+                         c.vocab_size, c.max_seq_len)
+        self.wte = self._param("wte", normal(ks[0], (V, H)), P("mp", None))
+        self.wpe = self._param("wpe", normal(ks[1], (S, H)), None)
+        blocks = {
+            "ln1_w": (jnp.ones((L, H)), P("pp", None)),
+            "ln1_b": (jnp.zeros((L, H)), P("pp", None)),
+            "qkv_w": (normal(ks[2], (L, H, 3 * H)), P("pp", None, "mp")),
+            "qkv_b": (jnp.zeros((L, 3 * H)), P("pp", "mp")),
+            "proj_w": (normal(ks[3], (L, H, H)) / math.sqrt(2 * L),
+                       P("pp", "mp", None)),
+            "proj_b": (jnp.zeros((L, H)), P("pp", None)),
+            "ln2_w": (jnp.ones((L, H)), P("pp", None)),
+            "ln2_b": (jnp.zeros((L, H)), P("pp", None)),
+            "fc1_w": (normal(ks[4], (L, H, F)), P("pp", None, "mp")),
+            "fc1_b": (jnp.zeros((L, F)), P("pp", "mp")),
+            "fc2_w": (normal(ks[5], (L, F, H)) / math.sqrt(2 * L),
+                      P("pp", "mp", None)),
+            "fc2_b": (jnp.zeros((L, H)), P("pp", None)),
+        }
+        self._block_params = {}
+        for name, (val, spec) in blocks.items():
+            self._block_params[name] = self._param(
+                "blocks." + name, val, spec)
+        self.lnf_w = self._param("lnf_w", jnp.ones((H,)), None)
+        self.lnf_b = self._param("lnf_b", jnp.zeros((H,)), None)
+
+    def _param(self, name, value, spec):
+        p = Parameter(jnp.asarray(value, jnp.float32), name=name)
+        p.dist_spec = spec
+        self.add_parameter(name.replace(".", "_"), p)
+        return p
+
+    def _params_tree(self):
+        return {
+            "wte": self.wte,
+            "wpe": self.wpe,
+            "blocks": dict(self._block_params),
+            "lnf_w": self.lnf_w,
+            "lnf_b": self.lnf_b,
+        }
+
+    def forward(self, input_ids):
+        c = self.config
+        drop = c.dropout if self.training else 0.0
+        key = _random.next_key() if drop > 0.0 else None
+        return apply_op("gpt_forward", _k_gpt_forward, input_ids,
+                        self._params_tree(), n_head=c.num_heads,
+                        eps=c.layer_norm_eps,
+                        use_flash=c.use_flash_attention, remat=c.remat,
+                        dropout=drop, key=key)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None):
+        if labels is None:
+            return self.gpt(input_ids)
+        c = self.config
+        drop = c.dropout if self.training else 0.0
+        key = _random.next_key() if drop > 0.0 else None
+        return apply_op("gpt_loss", _k_gpt_loss, input_ids, labels,
+                        self.gpt._params_tree(), n_head=c.num_heads,
+                        eps=c.layer_norm_eps,
+                        use_flash=c.use_flash_attention, remat=c.remat,
+                        dropout=drop, key=key)
+
+
+def gpt2_small(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, ffn_hidden=3072, **kw)
+
+
+def gpt2_345m(**kw):
+    """GPT-2 medium / Megatron 345M (BASELINE config 4)."""
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, ffn_hidden=4096, **kw)

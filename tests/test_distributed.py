@@ -1,0 +1,196 @@
+"""Distributed stack: mesh, topology, TP layers, sharded train step
+(reference: hybrid_parallel_* test family — here over an 8-virtual-CPU
+mesh per SURVEY §7)."""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed import (build_mesh, set_mesh, get_mesh, fleet)
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology, HybridCommunicateGroup)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    set_mesh(None)
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    assert mesh.shape == {"dp": 2, "mp": 4}
+    mesh = build_mesh({"dp": -1, "mp": 2})
+    assert mesh.shape["dp"] == 4
+
+
+def test_build_mesh_bad_size():
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 3, "mp": 4})
+
+
+def test_topology_coords():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "model", "sep"],
+                               [2, 2, 1, 2, 1])
+    assert topo.world_size == 8
+    assert topo.get_dim("model") == 2
+    c = topo.get_coord(0)
+    assert c.data == 0 and c.model == 0
+    groups = topo.get_comm_list("model")
+    assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+
+def test_hybrid_communicate_group():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "model", "sep"],
+                               [2, 2, 1, 2, 1])
+    hcg = HybridCommunicateGroup(topo)
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert hcg.get_parallel_mode() == "pipeline"
+    mesh = get_mesh()
+    assert mesh is not None and mesh.shape["mp"] == 2
+
+
+def test_fleet_init_and_wrappers():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    assert fleet.is_initialized()
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_parallel_mode() == "pipeline"
+    opt = fleet.distributed_optimizer(
+        optim.Adam(learning_rate=1e-3, parameters=[]))
+    assert opt.get_lr() == 1e-3
+
+
+def test_column_row_parallel_linear_math():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    col = ColumnParallelLinear(8, 16, gather_output=False)
+    row = RowParallelLinear(16, 8, input_is_parallel=True)
+    x = paddle.randn([2, 8])
+    out = row(col(x))
+    assert out.shape == [2, 8]
+    # eager single-process must equal a plain two-linear stack
+    ref = x.numpy() @ col.weight.numpy()
+    ref = ref + col.bias.numpy()
+    ref = ref @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    assert col.weight.dist_spec == P(None, "mp")
+    assert row.weight.dist_spec == P("mp", None)
+
+
+def test_vocab_parallel_embedding():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        VocabParallelEmbedding)
+
+    emb = VocabParallelEmbedding(100, 16)
+    ids = paddle.to_tensor(np.asarray([[1, 5], [7, 99]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 16]
+    assert emb.weight.dist_spec == P("mp", None)
+
+
+def test_pipeline_layer_segmentation():
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pipe = PipelineLayer(descs, num_stages=4,
+                         loss_fn=lambda o, l: (o - l).square().mean())
+    assert pipe.segment_parts == [0, 2, 4, 6, 8]
+    x = paddle.randn([2, 8])
+    out = pipe(x)
+    assert out.shape == [2, 8]
+    stages = {p.pp_stage for p in pipe.parameters()}
+    assert stages == {0, 1, 2, 3}
+
+
+def test_collectives_single_controller():
+    from paddle_tpu.distributed import all_reduce, all_gather, broadcast
+
+    t = paddle.to_tensor([1.0, 2.0])
+    out = all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+    res = []
+    all_gather(res, t)
+    assert len(res) >= 1
+    b = broadcast(t, src=0)
+    np.testing.assert_allclose(b.numpy(), [1.0, 2.0])
+
+
+def test_distributed_train_step_dp_mp():
+    """GPT tiny over dp=2×mp=2×pp=2 mesh — full hybrid step executes."""
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    mesh = build_mesh({"dp": 2, "pp": 2, "sp": 1, "mp": 2})
+    set_mesh(mesh)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=4, ffn_hidden=64, max_seq_len=16,
+                    remat=False, use_flash_attention=False, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    step = DistributedTrainStepCompiler(model, opt, mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (4, 16)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, 128, (4, 16)).astype(np.int32))
+    l1 = float(step(ids, labels).item())
+    losses = [l1]
+    for _ in range(8):
+        losses.append(float(step(ids, labels).item()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"hybrid step not learning: {losses}"
+    # weights really sharded on the mesh
+    wte_sharding = model.gpt.wte._value.sharding
+    assert "mp" in str(wte_sharding.spec) or wte_sharding.is_fully_replicated is False
+
+
+def test_distributed_matches_single_device():
+    """dp=8 data-parallel GPT step ≈ single-device step (same seed)."""
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, ffn_hidden=32, max_seq_len=8,
+                    remat=False, use_flash_attention=False, dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 64, (8, 8)).astype(np.int32)
+    lbl_np = rng.randint(0, 64, (8, 8)).astype(np.int32)
+
+    paddle.seed(7)
+    m1 = GPTForCausalLM(cfg)
+    o1 = optim.SGD(learning_rate=0.1, parameters=m1.parameters())
+    s1 = TrainStepCompiler(m1, o1)
+    l_single = float(s1(paddle.to_tensor(ids_np),
+                        paddle.to_tensor(lbl_np)).item())
+
+    paddle.seed(7)
+    mesh = build_mesh({"dp": 8})
+    set_mesh(mesh)
+    m2 = GPTForCausalLM(cfg)
+    o2 = optim.SGD(learning_rate=0.1, parameters=m2.parameters())
+    s2 = DistributedTrainStepCompiler(m2, o2, mesh=mesh)
+    l_dist = float(s2(paddle.to_tensor(ids_np),
+                      paddle.to_tensor(lbl_np)).item())
+    np.testing.assert_allclose(l_single, l_dist, rtol=1e-4)
+
+
+def test_group_sharded_tags_params():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    mesh = build_mesh({"dp": 2, "sharding": 4})
+    set_mesh(mesh)
+    model = nn.Linear(8, 8)
+    o = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model, o, _ = group_sharded_parallel(model, o)
+    assert model.weight.dist_spec is not None

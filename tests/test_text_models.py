@@ -1,0 +1,108 @@
+"""Language model zoo tests (BASELINE configs 3-5 shapes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu.jit import TrainStepCompiler
+
+
+def _tiny_gpt():
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    return GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, ffn_hidden=64, max_seq_len=16,
+                     remat=False, use_flash_attention=False, dropout=0.0)
+
+
+def test_gpt_forward_shapes():
+    from paddle_tpu.text.models.gpt import GPTModel
+
+    paddle.seed(0)
+    m = GPTModel(_tiny_gpt())
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype(
+        np.int32))
+    logits = m(ids)
+    assert logits.shape == [2, 16, 128]
+
+
+def test_gpt_loss_and_grads():
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM(_tiny_gpt())
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype(
+        np.int32))
+    loss = m(ids, ids)
+    assert np.isfinite(float(loss.item()))
+    loss.backward()
+    assert m.gpt.wte.grad is not None
+    assert m.gpt._block_params["qkv_w"].grad.shape == [2, 32, 96]
+
+
+def test_gpt_compiled_training_converges():
+    from paddle_tpu.text.models.gpt import GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM(_tiny_gpt())
+    o = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+    step = TrainStepCompiler(m, o)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (4, 16)).astype(
+        np.int32))
+    losses = [float(step(ids, ids).item()) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_bert_forward_and_loss():
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, ffn_hidden=64, max_seq_len=32,
+                     dropout=0.0)
+    m = BertForPretraining(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype(
+        np.int64))
+    mlm = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype(
+        np.int64))
+    nsp = paddle.to_tensor(np.asarray([0, 1], np.int64))
+    loss = m(ids, masked_lm_labels=mlm, next_sentence_label=nsp)
+    assert np.isfinite(float(loss.item()))
+    loss.backward()
+    assert m.bert.embeddings.word_embeddings.weight.grad is not None
+
+
+def test_bert_attention_mask():
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                     num_heads=4, ffn_hidden=64, dropout=0.0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.randint(0, 64, (1, 8)).astype(
+        np.int64))
+    mask = paddle.to_tensor(np.asarray([[1, 1, 1, 1, 0, 0, 0, 0]],
+                                       np.float32))
+    seq, pooled = m(ids, attention_mask=mask)
+    assert seq.shape == [1, 8, 32]
+    assert pooled.shape == [1, 32]
+
+
+def test_ernie_pipeline_model():
+    from paddle_tpu.text.models.ernie import ErnieConfig, ErnieForPretraining
+
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                      num_heads=4, ffn_hidden=64, max_seq_len=32,
+                      dropout=0.0, num_stages=2)
+    m = ErnieForPretraining(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 8)).astype(
+        np.int64))
+    labels = paddle.to_tensor(np.random.randint(0, 128, (2, 8)).astype(
+        np.int64))
+    loss = m(ids, labels)
+    assert np.isfinite(float(loss.item()))
+    loss.backward()
+    stages = {getattr(p, "pp_stage", None)
+              for p in m.ernie.parameters()}
+    assert 0 in stages and 1 in stages
