@@ -1,12 +1,21 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels (forward AND backward).
 
 Replaces the reference's fused CUDA attention
-(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with a
-TPU-native tiled kernel: online-softmax over KV tiles held in VMEM, so
-the [S, S] score matrix never materializes in HBM; QK^T and PV ride the
-MXU in fp32 accumulation. Forward is Pallas; backward is a custom-VJP
-recompute in XLA (einsum chain, fully fused) — flash backward kernel is
-a planned upgrade.
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with
+TPU-native tiled kernels: online-softmax over KV tiles streamed through
+VMEM, so neither the [S, S] score matrix nor full K/V ever sit in VMEM
+at once; QK^T and PV ride the MXU with fp32 accumulation.
+
+- forward: grid (batch*heads, q_blocks, kv_blocks); KV tiles are
+  streamed per grid step (block shape (1, block_k, d)) and the output
+  accumulator/running-max/denominator live in VMEM scratch. The
+  logsumexp per query row is written out for the backward pass.
+- backward: two kernels. dq iterates (bh, q_blocks, kv_blocks)
+  accumulating dq in scratch; dk/dv iterates (bh, kv_blocks, q_blocks)
+  accumulating dk and dv. Both recompute probabilities from q,k and the
+  saved logsumexp — the standard flash-attention backward, O(S) memory.
+- `interpret=True` runs the same kernels through the Pallas interpreter
+  so correctness is testable on CPU.
 """
 from __future__ import annotations
 
@@ -20,83 +29,284 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
+# per-row stats (lse/delta) ride a trailing lane dim; 8 satisfies the
+# TPU tiling rule (block last dim == full array dim) at 16x less HBM
+# than the 128-lane layout
+_STAT_LANES = 8
+
+
+def _pick_block(seq, preferred):
+    """Largest power-of-two block <= preferred that divides seq."""
+    for cand in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if cand <= preferred and cand <= seq and seq % cand == 0:
+            return cand
+    raise ValueError(
+        f"flash_attention: sequence length {seq} has no power-of-two "
+        f"block divisor <= {preferred}; pad the sequence")
+
 _NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
-               block_k, seq_k):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [BQ, D]
-    bq, d = q.shape
-    num_kv = seq_k // block_k
+def _causal_mask(s, qi, ki, block_q, block_k):
+    bq, bk = s.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-    def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   sm_scale, causal, block_q, block_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale       # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)                  # [BK, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_ref[:, :1]                             # [BQ, 1]
+        l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-
-    if causal:
-        # only iterate kv blocks at-or-below this q block's diagonal
-        upper = jnp.minimum(num_kv, (qi + 1) * block_q // block_k
-                            + (1 if block_q % block_k else 0))
-        upper = jnp.maximum(upper, 1)
-        acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    else:
-        acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
-
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        m = m_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            m + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[1:])
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                    interpret=False):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    num_kv = sk // bk
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
-    kernel = functools.partial(_fa_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=bq, block_k=bk, seq_k=sk)
-    out = pl.pallas_call(
+    kernel = functools.partial(
+        _fa_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, num_kv=num_kv)
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=(b * h, sq // bq),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sq, _STAT_LANES), jnp.float32)),
+        grid=(b * h, sq // bq, num_kv),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _STAT_LANES),
+                         lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq)
 
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_acc, *,
+                      sm_scale, causal, block_q, block_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                           # [BQ, 1]
+        delta = delta_ref[0][:, :1]                       # [BQ, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                              # [BQ, BK]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       sm_scale, causal, block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]                           # [BQ, 1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                              # [BQ, BK]
+        # dv_j += p^T @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale                  # [BQ, BK]
+        # dk_j += ds^T @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
+                    block_q, block_k, interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    num_q = sq // bq
+    num_kv = sk // bk
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = do.reshape(b * h, sq, d)
+    # per-row stats ride a 128-lane trailing dim (TPU block tiling)
+    lser = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
+                            (b * h, sq, _STAT_LANES))
+    # delta_i = rowsum(do_i * o_i) — cheap fused elementwise + reduce
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(b * h, sq)
+    delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, _STAT_LANES))
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                          memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, _STAT_LANES),
+                            lambda bh, qi, ki: (bh, qi, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          num_kv=num_kv),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dkv grid: (bh, kv_blocks, q_blocks) — q streams innermost
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0),
+                           memory_space=pltpu.VMEM)
+    k_spec2 = pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, bq, _STAT_LANES),
+                             lambda bh, ki, qi: (bh, qi, 0),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          num_q=num_q),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=(k_spec2, k_spec2),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
 
 def _attn_ref(q, k, v, causal, sm_scale):
+    """Dense reference (testing / tiny shapes only — O(S^2) HBM)."""
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if causal:
@@ -107,30 +317,25 @@ def _attn_ref(q, k, v, causal, sm_scale):
     return p, jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, sm_scale=1.0,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    return _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    out, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                             interpret)
+    return out
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v)
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, res, do):
-    q, k, v = res
-    p, _ = _attn_ref(q, k, v, causal, sm_scale)
-    p = p.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
-    ds = p * (dp - delta) * sm_scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
+                           block_q, block_k, interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)
