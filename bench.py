@@ -1,15 +1,27 @@
 """Benchmark entry (driver contract): prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}.
 
-Flagship metric (BASELINE.md): GPT-2 345M training throughput,
-tokens/sec/chip, full train step (fwd+bwd+AdamW) compiled via
-TrainStepCompiler, bf16 weights/activations on the MXU.
+Covers all five BASELINE.md configs:
+  1. MNIST LeNet        — imgs/s, compiled train step (f32)
+  2. ResNet-50          — imgs/s, SGD+momentum, O2 bf16 (BN stays f32)
+  3. BERT-base pretrain — tokens/s, Pallas flash-attention path
+  4. GPT-2 345M         — tokens/s (flagship; the headline metric)
+  5. ERNIE hybrid       — tokens/s through DistributedTrainStepCompiler
+                          (mp+pp machinery; single-chip mesh here)
 
-vs_baseline: ratio against the reference stack's nominal V100 number
-for Megatron-style GPT-2 345M fp16 training (~12k tokens/s/GPU) —
-BASELINE.md records no published numbers, so this constant is the
-documented stand-in for "CUDAPlace/V100 step time" (north star: ≥1/1.2
-≈ 0.83 of it).
+All half-precision configs use the reference's O2 numerics: bf16
+weights with fp32 master weights in the optimizer
+(multi_precision=True), norm layers kept f32 via amp.decorate. Every
+config asserts its loss decreased over the measured window.
+
+vs_baseline ratios use documented V100 stand-ins (BASELINE.md: the
+reference repo publishes no numbers, so these constants are the
+recorded "CUDAPlace/V100" proxies; north star >= 1/1.2 of them):
+  GPT-2 345M fp16   ~12,000 tokens/s/GPU (Megatron-LM V100 measurements)
+  ResNet-50 AMP     ~780 imgs/s/GPU (MLPerf-era V100 fp16)
+  BERT-base fp16    ~25,000 tokens/s/GPU (NVIDIA BERT repo, seq 512)
+  ERNIE-base fp16   ~25,000 tokens/s/GPU (BERT-base-shaped proxy)
+  LeNet MNIST       ~10,000 imgs/s (dygraph dispatch-bound V100 proxy)
 """
 from __future__ import annotations
 
@@ -19,15 +31,136 @@ import time
 
 import numpy as np
 
-V100_GPT2_345M_TOKENS_PER_SEC = 12000.0
+BASELINES = {
+    "gpt2_345m": 12000.0,
+    "resnet50": 780.0,
+    "bert_base": 25000.0,
+    "ernie": 25000.0,
+    "mnist_lenet": 10000.0,
+}
 
 
-def main():
-    import jax
+def _measure(step, args, steps, warmup):
+    for _ in range(warmup):
+        loss = step(*args)
+    first = float(loss.item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    last = float(loss.item())  # .item() syncs
+    dt = (time.perf_counter() - t0) / steps
+    return dt, first, last
 
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
 
+def _check_decreasing(name, first, last):
+    assert np.isfinite(last), f"{name}: non-finite loss {last}"
+    assert last < first, (
+        f"{name}: loss did not decrease over the bench window "
+        f"({first:.4f} -> {last:.4f})")
+
+
+def bench_mnist(on_tpu):
     import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    batch = 256 if on_tpu else 32
+    steps, warmup = (50, 5) if on_tpu else (3, 1)
+    net = LeNet()
+    ce = nn.CrossEntropyLoss()
+    opt = optim.Adam(learning_rate=1e-3, parameters=net.parameters())
+    step = TrainStepCompiler(net, opt,
+                             lambda o, y: ce(o, y))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    dt, first, last = _measure(step, (x, y), steps, warmup)
+    _check_decreasing("mnist", first, last)
+    return {"value": round(batch / dt, 1), "unit": "imgs/s"}
+
+
+def bench_resnet50(on_tpu):
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    batch = 64 if on_tpu else 2
+    size = 224 if on_tpu else 32
+    steps, warmup = (20, 3) if on_tpu else (2, 1)
+    net = resnet50()
+    if on_tpu:
+        net = amp.decorate(net, level="O2", dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=net.parameters(),
+                         multi_precision=on_tpu)
+    step = TrainStepCompiler(net, opt, lambda o, y: ce(o, y))
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    dt_in = jnp.bfloat16 if on_tpu else jnp.float32
+    x = paddle.to_tensor(
+        rng.randn(batch, 3, size, size).astype(np.float32))
+    x._value = x._value.astype(dt_in)
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    dt, first, last = _measure(step, (x, y), steps, warmup)
+    _check_decreasing("resnet50", first, last)
+    return {"value": round(batch / dt, 1), "unit": "imgs/s"}
+
+
+def bench_bert(on_tpu):
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig(dropout=0.0)  # bert-base
+        batch, seq, steps, warmup = 8, 512, 15, 3
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=2, ffn_hidden=256, max_seq_len=128,
+                         dropout=0.0)
+        batch, seq, steps, warmup = 2, 128, 2, 1
+    import paddle_tpu.nn as nn
+
+    class BertPretrainStep(nn.Layer):
+        """Fixed-signature wrapper so the whole batch is jit-traceable."""
+
+        def __init__(self, cfg):
+            super().__init__()
+            self.m = BertForPretraining(cfg)
+
+        def forward(self, ids, tt, labels):
+            return self.m(ids, token_type_ids=tt, masked_lm_labels=labels)
+
+    model = BertPretrainStep(cfg)
+    if on_tpu:
+        model = amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      weight_decay=0.01, multi_precision=on_tpu)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (batch, seq)).astype(np.int64))
+    step = TrainStepCompiler(model, opt, loss_fn=None)
+    tt = paddle.to_tensor(np.zeros((batch, seq), np.int64))
+    dt, first, last = _measure(step, (ids, tt, ids), steps, warmup)
+    _check_decreasing("bert", first, last)
+    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
+
+
+def bench_gpt2(on_tpu):
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
     import paddle_tpu.optimizer as optim
     from paddle_tpu.jit import TrainStepCompiler
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
@@ -38,7 +171,7 @@ def main():
                         num_heads=16, ffn_hidden=4096, max_seq_len=1024,
                         dropout=0.0, remat=True, use_flash_attention=True)
         batch, seq, steps, warmup = 8, 1024, 20, 3
-    else:  # CPU smoke (driver always runs on TPU; this keeps it runnable)
+    else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, ffn_hidden=256, max_seq_len=128,
                         dropout=0.0, remat=False, use_flash_attention=False)
@@ -46,41 +179,96 @@ def main():
 
     model = GPTForCausalLM(cfg)
     if on_tpu:
-        # bf16 weights: MXU-native (reference analog: pure-fp16 O2)
-        import jax.numpy as jnp
-
-        for _, p in model.named_parameters():
-            p._value = p._value.astype(jnp.bfloat16)
+        model = amp.decorate(model, level="O2", dtype="bfloat16")
     opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                      weight_decay=0.01)
+                      weight_decay=0.01, multi_precision=on_tpu)
     step = TrainStepCompiler(model, opt, loss_fn=None)
-
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                        (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                           (batch, seq)).astype(np.int32))
+    dt, first, last = _measure(step, (ids, labels), steps, warmup)
+    _check_decreasing("gpt2", first, last)
+    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
 
-    for _ in range(warmup):
-        loss = step(ids, labels)
-    loss.numpy()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, labels)
-    loss.numpy()  # sync
-    dt = (time.perf_counter() - t0) / steps
-    tokens_per_sec = batch * seq / dt
 
+def bench_ernie(on_tpu):
+    """ERNIE through the hybrid-parallel compiler (BASELINE config 5:
+    Fleet mp+pp). On a single chip the mesh is 1-device (mp=pp=1) —
+    the same code path the multichip dryrun runs with real axes."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.distributed import build_mesh, set_mesh
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+    from paddle_tpu.text.models.ernie import (ErnieConfig,
+                                              ErnieForPretraining)
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = ErnieConfig(vocab_size=18000, hidden_size=768,
+                          num_layers=12, num_heads=12, ffn_hidden=3072,
+                          max_seq_len=512, dropout=0.0)
+        batch, seq, steps, warmup = 8, 512, 15, 3
+    else:
+        cfg = ErnieConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=2, ffn_hidden=256, max_seq_len=128,
+                          dropout=0.0)
+        batch, seq, steps, warmup = 2, 128, 2, 1
+    model = ErnieForPretraining(cfg)
+    if on_tpu:
+        model = amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      weight_decay=0.01, multi_precision=on_tpu)
+    mesh = build_mesh({"dp": 1, "pp": 1, "mp": -1})
+    set_mesh(mesh)
+    step = DistributedTrainStepCompiler(model, opt, loss_fn=None,
+                                        mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                          (batch, seq)).astype(np.int64))
+    dt, first, last = _measure(step, (ids, labels), steps, warmup)
+    _check_decreasing("ernie", first, last)
+    set_mesh(None)
+    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    suite = {
+        "mnist_lenet": bench_mnist,
+        "resnet50": bench_resnet50,
+        "bert_base": bench_bert,
+        "gpt2_345m": bench_gpt2,
+        "ernie": bench_ernie,
+    }
+    results = {}
+    for name, fn in suite.items():
+        try:
+            r = fn(on_tpu)
+            r["vs_baseline"] = (round(r["value"] / BASELINES[name], 4)
+                                if on_tpu else 0.0)
+            results[name] = r
+            print(f"[bench] {name}: {r['value']} {r['unit']} "
+                  f"(vs_baseline {r['vs_baseline']})", file=sys.stderr)
+        except Exception as e:  # record, don't lose the other configs
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
+
+    flag = results.get("gpt2_345m", {})
     out = {
-        "metric": "gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu
-        else "gpt2_tiny_cpu_smoke_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        # the V100 ratio only makes sense for the real 345M TPU run;
-        # the CPU smoke is a different workload entirely
-        "vs_baseline": (round(tokens_per_sec
-                              / V100_GPT2_345M_TOKENS_PER_SEC, 4)
-                        if on_tpu else 0.0),
+        "metric": ("gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu
+                   else "gpt2_tiny_cpu_smoke_tokens_per_sec"),
+        "value": flag.get("value", 0.0),
+        "unit": flag.get("unit", "tokens/s"),
+        "vs_baseline": flag.get("vs_baseline", 0.0),
+        "extra": results,
     }
     print(json.dumps(out))
 

@@ -113,16 +113,47 @@ class auto_cast:
 amp_guard = auto_cast
 
 
+def _is_norm_layer(layer):
+    from ..nn.layer.norm import (_BatchNormBase, _InstanceNormBase,
+                                 GroupNorm, LayerNorm, RMSNorm)
+
+    return isinstance(layer, (_BatchNormBase, _InstanceNormBase, GroupNorm,
+                              LayerNorm, RMSNorm))
+
+
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
              master_weight=None, save_dtype=None):
-    """O2: cast model parameters to the amp dtype (master weights are
-    kept implicitly: optimizer states & updates run in fp32)."""
+    """O2: cast model parameters to the amp dtype, EXCEPT norm layers —
+    BatchNorm/LayerNorm/InstanceNorm/GroupNorm weights and running
+    stats stay float32, matching the reference's pure_fp16_initialize
+    (auto_cast.py) which skips _BatchNormBase/LayerNorm. Set
+    optimizer.multi_precision for fp32 master weights."""
     dt = convert_dtype(dtype)
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
     if level == "O2":
         for m in model_list:
-            m.to(dtype=dt)
+            stack = [m]
+            while stack:
+                lay = stack.pop()
+                stack.extend(lay._sub_layers.values())
+                if _is_norm_layer(lay):
+                    continue
+                for p in lay._parameters.values():
+                    # no_amp_cast: norm-scale params registered as raw
+                    # Parameters (e.g. GPT's stacked ln1_w) opt out the
+                    # same way real norm Layers do
+                    if (p is not None
+                            and not getattr(p, "no_amp_cast", False)
+                            and jnp.issubdtype(p._value.dtype,
+                                               jnp.floating)):
+                        p._value = p._value.astype(dt)
+                for b in lay._buffers.values():
+                    if (b is not None
+                            and not getattr(b, "no_amp_cast", False)
+                            and jnp.issubdtype(b._value.dtype,
+                                               jnp.floating)):
+                        b._value = b._value.astype(dt)
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

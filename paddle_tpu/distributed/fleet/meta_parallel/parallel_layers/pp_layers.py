@@ -150,6 +150,99 @@ class PipelineLayer(Layer):
                 x = lay(x)
         return x
 
+    # -- explicit pipeline schedule ------------------------------------
+    def _find_uniform_middle(self):
+        """Longest run of same-class Layer entries (the transformer
+        blocks) — the segment the GPipe schedule pipelines."""
+        entries = self.run_function
+        best = (0, 0)
+        i, n = 0, len(entries)
+        while i < n:
+            lay = entries[i][0]
+            if not isinstance(lay, Layer) or entries[i][2] is not None:
+                i += 1
+                continue
+            j = i
+            t = type(lay)
+            while (j < n and type(entries[j][0]) is t
+                   and entries[j][2] is None):
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
+
+    def can_pipeline(self, num_stages):
+        start, end = self._find_uniform_middle()
+        n = end - start
+        if n < num_stages or n % num_stages:
+            return False
+        # stage blocks with buffers can't be stacked (only parameters
+        # are rebound in apply_block) — fall back to plain forward
+        for lay, _, _ in self.run_function[start:end]:
+            if len(list(lay.named_buffers())):
+                return False
+        return True
+
+    def pipelined_forward(self, x, num_micro, num_stages):
+        """Forward through the explicit GPipe schedule: the uniform
+        middle runs vectorized-over-stages (stage dim sharded on 'pp',
+        shifts lowering to collective-permute); surrounding layers run
+        on the full batch. Falls back to plain forward when the layer
+        list can't be segmented. Must be called in a jit trace (the
+        compiled train step)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .....core.tensor import Tensor
+        from ....pipeline import gpipe_loop, microbatch, unmicrobatch
+
+        if not self.can_pipeline(num_stages) or num_micro < 2:
+            return self.forward(x)
+        start, end = self._find_uniform_middle()
+        blocks = [e[0] for e in self.run_function[start:end]]
+        for lay, _, fwd in self.run_function[:start]:
+            x = fwd(lay, x) if fwd is not None else lay(x)
+
+        proto = blocks[0]
+        names = [nm for nm, _ in proto.named_parameters()]
+        stacked = {
+            nm: jnp.stack([dict(b.named_parameters())[nm]._value
+                           for b in blocks])
+            for nm in names}
+        lps = len(blocks) // num_stages
+        stage_stacked = {
+            nm: a.reshape((num_stages, lps) + a.shape[1:])
+            for nm, a in stacked.items()}
+        param_refs = dict(proto.named_parameters())
+
+        def apply_block(pvals, xv):
+            # run the prototype block with its params rebound to this
+            # layer's slice (all ops are jnp under the jit trace)
+            saved = [(p, p._value) for p in param_refs.values()]
+            try:
+                for nm, p in param_refs.items():
+                    p._value = pvals[nm]
+                out = proto(Tensor(xv, stop_gradient=True,
+                                   _internal=True))
+                return out._value if isinstance(out, Tensor) else out
+            finally:
+                for p, v in saved:
+                    p._value = v
+
+        def stage_fn(stack_slice, sx):
+            out, _ = jax.lax.scan(
+                lambda c, pv: (apply_block(pv, c), None), sx, stack_slice)
+            return out
+
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        ym = gpipe_loop(stage_fn, stage_stacked,
+                        microbatch(xv, num_micro), num_stages)
+        x = Tensor(unmicrobatch(ym), stop_gradient=False, _internal=True)
+        for lay, _, fwd in self.run_function[end:]:
+            x = fwd(lay, x) if fwd is not None else lay(x)
+        return x
+
     @property
     def parameters_by_stage(self):
         out = {}

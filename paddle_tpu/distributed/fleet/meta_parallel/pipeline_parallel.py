@@ -2,11 +2,12 @@
 forward_backward_pipeline:80-150 1F1B; p2p via
 pp_utils/p2p_communication.py).
 
-TPU-native: train_batch splits the batch into micro-batches and
-accumulates gradients (GPipe schedule). Compiled over a mesh with a
-'pp' axis, stage parameters live on their stage's submesh and XLA
-pipelines the micro-batch loop across stages via ICI transfers —
-replacing send_v2/recv_v2 ops."""
+TPU-native: with a live mesh whose 'pp' axis is >1, train_batch
+compiles ONE train step that runs the explicit GPipe schedule
+(PipelineLayer.pipelined_forward — stage dim sharded over 'pp',
+micro-batch shifts lowering to ICI collective-permute; jax.grad
+reverses the schedule for the backward pipeline). Without a pp mesh it
+falls back to dygraph micro-batch gradient accumulation."""
 from __future__ import annotations
 
 import numpy as np
@@ -14,7 +15,32 @@ import numpy as np
 from ....core.engine import no_grad
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
+from ... import mesh as mesh_mod
 from .parallel_layers.pp_layers import PipelineLayer
+
+
+def _scalar_loss(loss):
+    """Reduce a per-token loss to the scalar the step optimizes."""
+    if getattr(loss, "size", 1) != 1:
+        from ....ops.math import mean
+
+        loss = mean(loss)
+    return loss
+
+
+class _PipelinedStep(Layer):
+    """forward(inputs, labels) -> loss through the GPipe schedule."""
+
+    def __init__(self, layers, num_micro, num_stages):
+        super().__init__()
+        self.layers = layers  # registers params via sublayer
+        self._num_micro = num_micro
+        self._num_stages = num_stages
+
+    def forward(self, inputs, labels):
+        out = self.layers.pipelined_forward(inputs, self._num_micro,
+                                            self._num_stages)
+        return _scalar_loss(self.layers._loss_fn(out, labels))
 
 
 class PipelineParallel(Layer):
@@ -29,12 +55,59 @@ class PipelineParallel(Layer):
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.total_loss = None
+        self._compiled_step = None
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
+    def _pp_degree(self):
+        mesh = mesh_mod.get_mesh()
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            return mesh.shape["pp"]
+        return 1
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """micro-batched fwd/bwd with gradient accumulation (GPipe)."""
+        """One optimizer step over the batch. Compiled GPipe schedule
+        when a pp>1 mesh is live; dygraph accumulation otherwise."""
+        inputs, labels = data
+        pp = self._pp_degree()
+        n_micro = max(self.accumulate_steps, 1)
+        use_compiled = (pp > 1 and n_micro > 1 and scaler is None
+                        and self._layers.can_pipeline(pp)
+                        and inputs.shape[0] % n_micro == 0)
+        if self._compiled_step is not None:
+            # once compiled, the functional optimizer state lives inside
+            # the compiled step — silently switching to the dygraph path
+            # (or to another optimizer) would fork/reset that state
+            if not use_compiled:
+                raise RuntimeError(
+                    "PipelineParallel.train_batch was compiled for the "
+                    "pp>1 schedule; cannot switch to the dygraph path "
+                    "(mesh/scaler/micro-batch conditions changed) "
+                    "mid-training without losing optimizer state")
+            if optimizer is not self._compiled_step._opt:
+                raise RuntimeError(
+                    "train_batch compiled with a different optimizer "
+                    "instance; optimizer state cannot be transferred")
+        if use_compiled:
+            if self._compiled_step is None:
+                from ....jit.distributed import (
+                    DistributedTrainStepCompiler)
+
+                module = _PipelinedStep(self._layers, n_micro, pp)
+                self._compiled_step = DistributedTrainStepCompiler(
+                    module, optimizer, loss_fn=None,
+                    mesh=mesh_mod.get_mesh())
+            loss = self._compiled_step(inputs, labels)
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
+        return self._train_batch_dygraph(data, optimizer, lr_scheduler,
+                                         scaler)
+
+    def _train_batch_dygraph(self, data, optimizer, lr_scheduler=None,
+                             scaler=None):
+        """micro-batched fwd/bwd with gradient accumulation."""
         inputs, labels = data
         n_micro = self.accumulate_steps
         losses = []
@@ -44,7 +117,7 @@ class PipelineParallel(Layer):
         micro_labels = split(labels, n_micro, axis=0) if n_micro > 1 else [labels]
         for mi, ml in zip(micro_inputs, micro_labels):
             out = self._layers(mi)
-            loss = self._layers._loss_fn(out, ml)
+            loss = _scalar_loss(self._layers._loss_fn(out, ml))
             scaled = loss.scale(1.0 / n_micro)
             if scaler is not None:
                 scaler.scale(scaled).backward()

@@ -64,13 +64,18 @@ def _dim_numbers(ndim_spatial, channel_last):
 
 
 def _k_conv(x, w, bias, stride, padding, dilation, groups, dn):
+    if x.dtype != w.dtype:
+        # mixed precision (e.g. f32 BatchNorm output into a bf16-cast
+        # conv under AMP O2): lax.conv requires matching dtypes —
+        # compute in the weight's dtype, the AMP intent
+        x = x.astype(w.dtype)
+    # no preferred_element_type: its f32 cotangent breaks the conv
+    # transpose rule against bf16 operands; the TPU MXU accumulates
+    # conv partials in f32 internally regardless
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=stride, padding=padding,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        feature_group_count=groups)
     if bias is not None:
         if dn[2].endswith("C"):
             out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))
@@ -118,6 +123,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def _k_conv_transpose(x, w, bias, stride, padding, dilation, groups, dn,
                       output_padding):
     # gradient-of-conv formulation: lhs_dilation implements the stride
+    if x.dtype != w.dtype:
+        x = x.astype(w.dtype)
     n = len(stride)
     if isinstance(padding, str):
         pad = padding

@@ -72,8 +72,15 @@ class Optimizer:
         return slots
 
     def _create_slots(self, p: Tensor):
-        return {name: jnp.zeros(tuple(p.shape), jnp.float32)
-                for name in self._slot_names}
+        slots = {name: jnp.zeros(tuple(p.shape), jnp.float32)
+                 for name in self._slot_names}
+        if self._multi_precision and p._value.dtype in (jnp.bfloat16,
+                                                        jnp.float16):
+            # O2 master weights: fp32 copy updated each step, half-
+            # precision param re-derived from it (reference:
+            # optimizer.py _create_master_weight / fp16_utils.py)
+            slots["master_weight"] = p._value.astype(jnp.float32)
+        return slots
 
     # -- core rule (override) ---------------------------------------------
     def _update(self, param, grad, slots, lr):
@@ -104,12 +111,21 @@ class Optimizer:
             gv = g._value if isinstance(g, Tensor) else g
             gv = gv.astype(jnp.float32)
             pv = p._value
-            if wd and not decoupled:
-                gv = gv + wd * pv.astype(jnp.float32)
             slots = self._get_slots(p)
+            mw = slots.get("master_weight")
+            base = mw if mw is not None else pv
+            if wd and not decoupled:
+                gv = gv + wd * base.astype(jnp.float32)
             self._current_param_name = p.name
-            new_p, new_slots = self._update(pv, gv, slots, lr)
-            p._value = new_p
+            if mw is not None:
+                sub = {k: v for k, v in slots.items()
+                       if k != "master_weight"}
+                new_master, new_slots = self._update(mw, gv, sub, lr)
+                new_slots["master_weight"] = new_master
+                p._value = new_master.astype(pv.dtype)
+            else:
+                new_p, new_slots = self._update(pv, gv, slots, lr)
+                p._value = new_p
             self._accumulators[p.name] = new_slots
         self._current_param_name = None
         self._step_count += 1
@@ -131,12 +147,19 @@ class Optimizer:
     # -- functional API for the jit harness -------------------------------
     def init_state(self, params: dict):
         """params: name -> array. Returns state pytree."""
-        return {name: {s: jnp.zeros(v.shape, jnp.float32)
-                       for s in self._slot_names}
-                for name, v in params.items()}
+        state = {name: {s: jnp.zeros(v.shape, jnp.float32)
+                        for s in self._slot_names}
+                 for name, v in params.items()}
+        if self._multi_precision:
+            for name, v in params.items():
+                if v.dtype in (jnp.bfloat16, jnp.float16):
+                    state[name]["master_weight"] = v.astype(jnp.float32)
+        return state
 
     def apply_gradients(self, params: dict, grads: dict, state: dict, lr):
-        """Pure: used inside jit. Applies clip + wd + rule."""
+        """Pure: used inside jit. Applies clip + wd + rule. When a
+        'master_weight' slot exists (multi_precision), the fp32 master
+        is updated and the half-precision param re-derived from it."""
         if self._grad_clip is not None:
             grads = self._grad_clip.functional_clip(grads)
         wd = self._wd_coeff()
@@ -149,11 +172,23 @@ class Optimizer:
                 new_state[name] = state[name]
                 continue
             g = g.astype(jnp.float32)
+            mw = state[name].get("master_weight")
+            base = mw if mw is not None else pv
             if wd and not decoupled:
-                g = g + wd * pv.astype(jnp.float32)
-            np_, ns_ = self._update(pv, g, state[name], lr)
-            new_params[name] = np_
+                g = g + wd * base.astype(jnp.float32)
+            self._current_param_name = name
+            if mw is not None:
+                sub = {k: v for k, v in state[name].items()
+                       if k != "master_weight"}
+                new_master, ns_ = self._update(mw, g, sub, lr)
+                ns_ = dict(ns_)
+                ns_["master_weight"] = new_master
+                new_params[name] = new_master.astype(pv.dtype)
+            else:
+                np_, ns_ = self._update(pv, g, state[name], lr)
+                new_params[name] = np_
             new_state[name] = ns_
+        self._current_param_name = None
         return new_params, new_state
 
     # -- state dict -------------------------------------------------------

@@ -48,6 +48,11 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_flash_attention: bool = True
     remat: bool = True  # jax.checkpoint each block (recompute analog)
+    # explicit GPipe schedule over the 'pp' mesh axis: num_layers is
+    # cut into pp_num_stages stages and the batch into
+    # pp_microbatches micro-batches (0 = plain scan-over-layers)
+    pp_num_stages: int = 0
+    pp_microbatches: int = 0
 
 
 def _maybe_constrain(x, spec):
@@ -130,7 +135,7 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout):
 
 
 def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
-                   dropout=0.0, key=None):
+                   dropout=0.0, key=None, pp_stages=0, pp_microbatches=0):
     x = jnp.take(params["wte"], ids, axis=0)
     pos = jnp.arange(ids.shape[1])
     x = x + jnp.take(params["wpe"], pos, axis=0)
@@ -155,7 +160,29 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
                          dropout)
         return out, None
 
-    if layer_keys is not None:
+    if pp_stages > 1 and pp_microbatches > 1:
+        # explicit GPipe schedule: stages over 'pp', micro-batched loop
+        if layer_keys is not None:
+            raise ValueError("GPipe path requires dropout=0.0 for now")
+        if n_layers % pp_stages:
+            raise ValueError(f"{n_layers} layers not divisible into "
+                             f"{pp_stages} pipeline stages")
+        from ...distributed.pipeline import (gpipe_loop, microbatch,
+                                             unmicrobatch)
+
+        lps = n_layers // pp_stages
+        stage_blocks = jax.tree_util.tree_map(
+            lambda a: a.reshape((pp_stages, lps) + a.shape[1:]), blocks)
+
+        def stage_fn(bp_stack, sx):
+            out, _ = jax.lax.scan(lambda c, lp: scan_body(c, (lp, None)),
+                                  sx, bp_stack)
+            return out
+
+        xm = microbatch(x, pp_microbatches)
+        ym = gpipe_loop(stage_fn, stage_blocks, xm, pp_stages)
+        x = unmicrobatch(ym)
+    elif layer_keys is not None:
         x, _ = jax.lax.scan(scan_body, x, (blocks, layer_keys))
     else:
         x, _ = jax.lax.scan(lambda c, lp: scan_body(c, (lp, None)), x,
@@ -167,11 +194,11 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
 
 
 def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
-                dropout=0.0, key=None):
+                dropout=0.0, key=None, pp_stages=0, pp_microbatches=0):
     """Causal-LM loss with the standard next-token shift: position t
     predicts labels[t+1] (HF convention — pass labels=input_ids)."""
     logits = _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
-                            dropout, key)
+                            dropout, key, pp_stages, pp_microbatches)
     lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = labels[:, 1:]
     picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
@@ -223,6 +250,11 @@ class GPTModel(Layer):
     def _param(self, name, value, spec):
         p = Parameter(jnp.asarray(value, jnp.float32), name=name)
         p.dist_spec = spec
+        # layer-norm scales/shifts stay f32 under amp O2 (reference
+        # pure_fp16_initialize skips LayerNorm)
+        base = name.rsplit(".", 1)[-1]
+        if base.startswith(("ln1_", "ln2_", "lnf_")):
+            p.no_amp_cast = True
         self.add_parameter(name.replace(".", "_"), p)
         return p
 
@@ -243,7 +275,8 @@ class GPTModel(Layer):
                         self._params_tree(), n_head=c.num_heads,
                         eps=c.layer_norm_eps,
                         use_flash=c.use_flash_attention, remat=c.remat,
-                        dropout=drop, key=key)
+                        dropout=drop, key=key, pp_stages=c.pp_num_stages,
+                        pp_microbatches=c.pp_microbatches)
 
 
 class GPTForCausalLM(Layer):
@@ -262,7 +295,8 @@ class GPTForCausalLM(Layer):
                         self.gpt._params_tree(), n_head=c.num_heads,
                         eps=c.layer_norm_eps,
                         use_flash=c.use_flash_attention, remat=c.remat,
-                        dropout=drop, key=key)
+                        dropout=drop, key=key, pp_stages=c.pp_num_stages,
+                        pp_microbatches=c.pp_microbatches)
 
 
 def gpt2_small(**kw):

