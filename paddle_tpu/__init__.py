@@ -49,6 +49,7 @@ from . import distributed
 from . import device
 from . import autograd
 from . import incubate
+from . import inference
 from . import profiler
 from . import text
 from . import hub

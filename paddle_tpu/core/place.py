@@ -80,13 +80,18 @@ class DeviceContext:
     def device(self):
         if self._device is None:
             want = self.place.device_type
-            devs = [d for d in jax.devices() if _platform_of(d) == want]
+            # LOCAL devices only: under multi-process SPMD, eager
+            # tensors must live on this process's devices (global
+            # jax.devices() includes non-addressable peers)
+            devs = [d for d in jax.local_devices()
+                    if _platform_of(d) == want]
             if not devs:
                 if want == "tpu":
                     # fall back to whatever accelerator exists, else cpu
-                    devs = jax.devices()
+                    devs = jax.local_devices()
                 else:
-                    devs = jax.devices("cpu")
+                    devs = [d for d in jax.local_devices()
+                            if d.platform == "cpu"] or jax.local_devices()
             self._device = devs[min(self.place.device_id, len(devs) - 1)]
         return self._device
 

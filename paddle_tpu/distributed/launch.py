@@ -1,0 +1,165 @@
+"""paddle.distributed.launch — multi-process launcher CLI.
+
+Parity target: python/paddle/distributed/fleet/launch.py
+(launch_collective:370) + launch_utils.py: build the cluster/pod
+topology from CLI/env, spawn one worker process per device slot with
+the PADDLE_* env contract, relay logs, propagate failures.
+
+TPU-native mapping: one process per HOST (a TPU host owns all its
+local chips through one PJRT client), not per chip; the env contract
+feeds jax.distributed.initialize (see parallel.py) instead of NCCL
+comm-id rendezvous. On CPU (tests), --nproc_per_node spawns several
+single-device processes with gloo collectives.
+
+usage:
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 \
+        train.py --my-arg ...
+    python -m paddle_tpu.distributed.launch --ips host1,host2 \
+        --node_rank 0 train.py        # one process per host
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["main", "get_cluster_env"]
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-process distributed launcher")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node (CPU testing; "
+                        "TPU hosts run one process per host)")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host list")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="index of this node in --ips (auto-detected "
+                        "from hostname/POD_IP when omitted)")
+    p.add_argument("--start_port", type=int, default=None,
+                   help="base port for trainer endpoints "
+                        "(default: a free port, or env PADDLE_PORT)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank logs here instead of stdout")
+    p.add_argument("--backend", type=str, default=None,
+                   help="force JAX_PLATFORMS for workers (e.g. cpu)")
+    p.add_argument("--device_count", type=int, default=None,
+                   help="virtual CPU devices per worker "
+                        "(xla_force_host_platform_device_count)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _detect_node_rank(ips):
+    if len(ips) == 1:
+        return 0
+    me = {os.environ.get("POD_IP", ""), socket.gethostname()}
+    try:
+        me.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for i, ip in enumerate(ips):
+        if ip in me:
+            return i
+    raise RuntimeError(f"cannot find this host in --ips {ips}; "
+                       "pass --node_rank")
+
+
+def get_cluster_env(args):
+    """Compute the (endpoints, node_rank) topology."""
+    ips = [h.strip() for h in args.ips.split(",") if h.strip()]
+    nper = max(args.nproc_per_node, 1)
+    port0 = args.start_port or int(os.environ.get("PADDLE_PORT", 0)) \
+        or _free_port()
+    endpoints = [f"{ip}:{port0 + i}" for ip in ips for i in range(nper)]
+    node_rank = (args.node_rank if args.node_rank is not None
+                 else _detect_node_rank(ips))
+    return endpoints, node_rank, nper
+
+
+def _worker_env(args, endpoints, rank, local_rank):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        "PADDLE_MASTER": endpoints[0],
+    })
+    if args.backend:
+        env["JAX_PLATFORMS"] = args.backend
+        env["PADDLE_TPU_PLATFORM"] = args.backend
+    if args.device_count:
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_"
+                            f"device_count={args.device_count}").strip()
+    return env
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    endpoints, node_rank, nper = get_cluster_env(args)
+    procs = []
+    log_files = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank in range(nper):
+        rank = node_rank * nper + local_rank
+        env = _worker_env(args, endpoints, rank, local_rank)
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        if args.log_dir:
+            lf = open(os.path.join(args.log_dir,
+                                   f"workerlog.{local_rank}"), "w")
+            log_files.append(lf)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=lf,
+                                          stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    rc = 0
+    try:
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                r = p.poll()
+                if r is None:
+                    continue
+                alive.remove(p)
+                if r != 0:
+                    rc = r
+                    # one trainer died — bring the pod down (reference
+                    # launch_utils watch_local_trainers behavior)
+                    _terminate()
+            time.sleep(0.2)
+    finally:
+        _terminate()
+        for p in procs:
+            p.wait()
+        for lf in log_files:
+            lf.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

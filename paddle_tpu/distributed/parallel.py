@@ -12,15 +12,52 @@ Reducer's fused buckets)."""
 from __future__ import annotations
 
 import contextlib
+import os
 
 from ..core.tensor import Tensor
 from ..nn import Layer
 from . import mesh as mesh_mod
 from .env import ParallelEnv, get_rank, get_world_size
 
+_initialized = [False]
+
+
+def _maybe_init_jax_distributed():
+    """Multi-process bootstrap (reference: gen_comm_id_helper.cc TCP
+    rendezvous + c_comm_init ops): the PADDLE_* env contract set by
+    `paddle.distributed.launch` maps onto jax.distributed.initialize —
+    the coordinator (trainer 0's endpoint) plays the comm-id server,
+    and every process contributes its local devices to the global
+    device set that meshes are built over."""
+    import jax
+
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nranks <= 1 or _initialized[0]:
+        return
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    coordinator = os.environ.get("PADDLE_MASTER") or (
+        eps.split(",")[0] if eps else None)
+    if coordinator is None:
+        raise RuntimeError(
+            "multi-process run needs PADDLE_TRAINER_ENDPOINTS or "
+            "PADDLE_MASTER to locate the coordinator (set by "
+            "paddle.distributed.launch)")
+    try:
+        # CPU backend: cross-process collectives ride gloo
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nranks, process_id=rank)
+    _initialized[0] = True
+
 
 def init_parallel_env():
-    """Bootstrap: build the default data-parallel mesh over all devices."""
+    """Bootstrap: connect to the multi-process world if the launch env
+    contract is present, then build the default data-parallel mesh over
+    all (global) devices."""
+    _maybe_init_jax_distributed()
     mesh_mod.ensure_mesh(dp=-1)
     return ParallelEnv()
 

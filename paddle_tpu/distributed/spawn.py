@@ -1,15 +1,85 @@
-"""paddle.distributed.spawn (reference: python/paddle/distributed/spawn.py).
-Single-controller SPMD: JAX owns all local devices in one process, so
-spawn degenerates to running the function once (nprocs>1 with separate
-processes would fight over the TPU). Multi-host uses one process per
-host, launched externally (launch module)."""
+"""paddle.distributed.spawn (reference: python/paddle/distributed/spawn.py
+— start_processes via multiprocessing, env contract per child).
+
+TPU-native caveat: a TPU host's chips belong to ONE process (the PJRT
+client), so on TPU the normal topology is one process per host, set up
+by the launch CLI — spawn with nprocs>1 is the CPU/testing path (each
+child gets its own CPU backend and gloo collectives).
+"""
 from __future__ import annotations
 
+import multiprocessing as mp
+import os
+import socket
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+__all__ = ["spawn", "ProcessContext"]
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_entry(func, args, env, platform):
+    os.environ.update(env)
+    if platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    from .parallel import init_parallel_env
+
+    init_parallel_env()
     func(*args)
 
 
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False,
+          backend=None, **options):
+    """Launch `func` in nprocs processes with the distributed env
+    contract initialized (rank, endpoints, coordinator)."""
+    if nprocs in (-1, 0, 1):
+        # single process: run inline (all local devices in-process)
+        func(*args)
+        return ProcessContext([])
+
+    platform = backend if backend not in (None, "xla") else (
+        options.get("platform") or os.environ.get(
+            "PADDLE_TPU_SPAWN_PLATFORM", "cpu"))
+    port = _free_port()
+    endpoints = ",".join(f"127.0.0.1:{port + i}" for i in range(nprocs))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+            "PADDLE_RANK_IN_NODE": str(rank),
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+        }
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, args, env, platform), daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = ProcessContext(procs)
+    if join:
+        context.join()
+    return context
+
+
 class ProcessContext:
-    def join(self):
+    def __init__(self, processes):
+        self.processes = processes
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        bad = [p.exitcode for p in self.processes if p.exitcode]
+        if bad:
+            raise RuntimeError(
+                f"spawned trainer process failed with exit codes {bad}")
         return True

@@ -1,0 +1,148 @@
+"""paddle.inference — deployment API.
+
+Parity target: paddle/fluid/inference/api/analysis_predictor.cc:160
+(Config -> create_predictor -> zero-copy handles -> Run) and
+paddle_infer python wrappers (python/paddle/inference/__init__.py).
+
+TPU-native design: a saved model is a serialized StableHLO program
+(jit.save) + params. create_predictor deserializes it and XLA compiles
+for the target device — the analog of the analysis passes + engine
+build; "zero-copy" handles wrap device arrays directly. The IR pass
+pipeline (fusion/quant subgraphs) is subsumed by XLA's compiler.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    TPU = 4
+    GPU = 1  # accepted for compat; maps to the best local device
+
+
+class Config:
+    """reference: paddle/fluid/inference/api/paddle_analysis_config.h."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # accept either the jit.save prefix or the .pdmodel path
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+
+    def set_prog_file(self, path):
+        self.__init__(path)
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "tpu", device_id  # best device
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Zero-copy IO handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, predictor, index, is_input):
+        self._p = predictor
+        self._i = index
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        assert self._is_input
+        self._p._inputs[self._i] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        assert not self._is_input
+        return np.asarray(self._p._outputs[self._i])
+
+    def shape(self):
+        v = (self._p._inputs[self._i] if self._is_input
+             else self._p._outputs[self._i])
+        return list(np.shape(v))
+
+
+class Predictor:
+    """reference: analysis_predictor.h:87 AnalysisPredictor."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._layer = jit_load(config._prefix)
+        n_in = len(self._layer._input_spec)
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs = [None] * len(self._input_names)
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return Tensor(self, self._input_names.index(name), True)
+
+    def run(self, inputs=None):
+        import jax
+
+        if inputs is not None:
+            self._inputs = [np.asarray(i) for i in inputs]
+        if any(i is None for i in self._inputs):
+            raise RuntimeError("not all inputs set (copy_from_cpu)")
+        out = self._layer(*self._inputs)
+        flat = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "_value"))
+        self._outputs = [o._value if hasattr(o, "_value") else o
+                         for o in flat]
+        return [np.asarray(o) for o in self._outputs]
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        idx = int(name.replace("out", "") or 0)
+        return Tensor(self, idx, False)
+
+    def clone(self):
+        import copy
+
+        return copy.copy(self)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

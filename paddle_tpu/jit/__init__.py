@@ -203,48 +203,202 @@ def not_to_static(func):
 
 
 class TracedLayer:
-    def __init__(self, layer, out):
+    """reference: fluid/dygraph/jit.py TracedLayer — trace once, run
+    the compiled function, optionally export for inference."""
+
+    def __init__(self, layer, static_fn, input_spec):
         self._layer = layer
+        self._static_fn = static_fn
+        self._input_spec = input_spec
 
     @staticmethod
     def trace(layer, inputs):
-        out = layer(*inputs)
-        return out, TracedLayer(layer, out)
+        from ..nn import Layer
+
+        fn = layer.forward if isinstance(layer, Layer) else layer
+        sf = fn if isinstance(fn, StaticFunction) else StaticFunction(fn)
+        out = sf(*inputs)
+        spec = [InputSpec(shape=list(i.shape), dtype=str(i.dtype))
+                for i in inputs if isinstance(i, Tensor)]
+        return out, TracedLayer(layer, sf, spec)
 
     def __call__(self, *args):
-        return self._layer(*args)
+        return self._static_fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._layer, path, input_spec=self._input_spec)
+
+
+def _specs_to_avals(specs):
+    """InputSpecs -> ShapeDtypeStructs; None/-1 dims become ONE shared
+    symbolic dim (the batch) across ALL inputs — a single symbolic
+    scope, since jax.export rejects mixing scopes (reference analog:
+    TRT dynamic-shape profiles)."""
+    from jax import export as jexport
+
+    from ..core.dtype import convert_dtype
+
+    sym = None
+    avals = []
+    for spec in specs:
+        shape = list(spec.shape if spec.shape is not None else [])
+        if any(d in (None, -1) for d in shape):
+            if sym is None:
+                sym = jexport.symbolic_shape("_pb")[0]
+            shape = [sym if d in (None, -1) else int(d) for d in shape]
+        avals.append(jax.ShapeDtypeStruct(
+            tuple(shape), convert_dtype(spec.dtype or "float32")))
+    return avals
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save — persists state_dict + a marker (program serialization
-    of compiled executables is planned; reference jit.save writes
-    a Program + params)."""
-    from .. import framework
+    """jit.save — serialize the traced computation (jax.export /
+    StableHLO) + parameters, reloadable WITHOUT the Python class.
 
-    framework.save(layer.state_dict(), path + ".pdparams")
-    meta = {"class": type(layer).__name__,
-            "input_spec": [repr(s) for s in (input_spec or [])]}
-    import json
+    Parity: reference jit.save writes Program + params
+    (fluid/dygraph/jit.py); here the "Program" is the exported
+    StableHLO module (path.pdmodel) and params/buffers are
+    path.pdiparams. The module is portable across processes and
+    compiled by XLA at load time (serialized per-chip executables are
+    not portable across runtime versions, StableHLO is).
+    """
     import os
+    import pickle
+
+    from jax import export as jexport
+
+    from .. import framework
+    from ..nn import Layer
+
+    target = layer.forward if isinstance(layer, Layer) else layer
+    if isinstance(target, StaticFunction):
+        if input_spec is None:
+            input_spec = target._input_spec
+        target = target.dygraph_function
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (shapes/dtypes of "
+                         "the forward inputs) to trace the model")
+    # resolve the Layer that owns the params: the layer itself, or the
+    # bound instance of a plain/StaticFunction method
+    owner = layer if isinstance(layer, Layer) else getattr(
+        target, "__self__", None)
+    if isinstance(owner, Layer):
+        params = dict(owner.named_parameters())
+        bufs = dict(owner.named_buffers())
+    else:
+        params, bufs = {}, {}  # pure function of its inputs
+    was_training = getattr(owner, "training", False)
+    if isinstance(owner, Layer):
+        owner.eval()  # inference graph: no dropout
+    p_items = list(params.items())
+    b_items = list(bufs.items())
+    box = {}
+
+    def fn(pvals, bvals, *avals):
+        with engine.trace_mode():
+            saved = []
+            try:
+                for (k, p) in p_items + b_items:
+                    saved.append((p, p._value))
+                for (k, p) in p_items:
+                    p._value = pvals[k]
+                for (k, b) in b_items:
+                    b._value = bvals[k]
+                args = [Tensor(a, stop_gradient=True, _internal=True)
+                        for a in avals]
+                out = target(*args)
+                flat, treedef = tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                box["treedef"] = treedef
+                return [o._value if isinstance(o, Tensor) else o
+                        for o in flat]
+            finally:
+                for p, v in saved:
+                    p._value = v
+
+    avals = _specs_to_avals(input_spec)
+    pvals = {k: jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+             for k, p in p_items}
+    bvals = {k: jax.ShapeDtypeStruct(b._value.shape, b._value.dtype)
+             for k, b in b_items}
+    exported = jexport.export(jax.jit(fn))(pvals, bvals, *avals)
+    if isinstance(owner, Layer) and was_training:
+        owner.train()
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path + ".pdmodel.json", "w") as f:
-        json.dump(meta, f)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    framework.save({"params": {k: p for k, p in params.items()},
+                    "buffers": {k: b for k, b in bufs.items()}},
+                   path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"out_treedef": box["treedef"],
+                     "input_spec": [(s.shape, str(s.dtype))
+                                    for s in input_spec],
+                     "class": type(layer).__name__}, f)
+
+
+class TranslatedLayer:
+    """Runnable loaded model (reference: fluid/dygraph/io.py
+    TranslatedLayer) — calls the deserialized StableHLO program; the
+    original Python class is not needed."""
+
+    def __init__(self, exported, params, buffers, out_treedef,
+                 input_spec=None):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+        self._out_treedef = out_treedef
+        self._input_spec = input_spec or []
+        self.training = False
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def forward(self, *inputs):
+        pvals = {k: v._value if isinstance(v, Tensor) else v
+                 for k, v in self._params.items()}
+        bvals = {k: v._value if isinstance(v, Tensor) else v
+                 for k, v in self._buffers.items()}
+        avals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                 for i in inputs]
+        flat = self._exported.call(pvals, bvals, *avals)
+        out = [Tensor(v, stop_gradient=True, _internal=True)
+               for v in flat]
+        return tree_util.tree_unflatten(self._out_treedef, out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (the "
+                           "exported program has no backward)")
+
+    def state_dict(self):
+        sd = dict(self._params)
+        sd.update(self._buffers)
+        return sd
+
+    def parameters(self):
+        return list(self._params.values())
 
 
 def load(path, **configs):
+    """jit.load — rebuild a runnable layer from jit.save artifacts."""
+    import pickle
+
+    from jax import export as jexport
+
     from .. import framework
 
-    state = framework.load(path + ".pdparams")
-
-    class TranslatedLayer:
-        def __init__(self, state):
-            self._state = state
-
-        def state_dict(self):
-            return self._state
-
-    return TranslatedLayer(state)
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    state = framework.load(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(exported, state["params"], state["buffers"],
+                           meta["out_treedef"],
+                           input_spec=meta.get("input_spec"))
 
 
 class TrainStepCompiler:
@@ -296,8 +450,9 @@ class TrainStepCompiler:
         fvals = {k: p._value for k, p in frozen.items()}
         bvals = {k: b._value for k, b in bufs.items()}
         avals = self._place_batch(batch)
-        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        rngc = jnp.asarray(self._step, jnp.uint32)
+        # host scalars (jit globalizes them under any mesh/process set)
+        lr = np.float32(self._opt.get_lr())
+        rngc = np.uint32(self._step)
         new_p, new_opt, new_b, loss = self._compiled(
             pvals, self._opt_state, fvals, bvals, avals, lr, rngc)
         self._opt_state = new_opt

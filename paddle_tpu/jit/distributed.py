@@ -77,6 +77,16 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                 else P(*(("dp",) + (None,) * (ndim - 1))))
         return NamedSharding(self._mesh, filter_spec(spec, self._mesh))
 
+    @staticmethod
+    def _hostify(v):
+        """Multi-process: device_put of a process-local jax.Array onto
+        a global (cross-process) sharding is rejected; route through
+        host memory (every process holds the same value by seed
+        discipline — the c_broadcast-at-startup analog)."""
+        if jax.process_count() > 1:
+            return np.asarray(v)
+        return v
+
     # -- hook overrides ---------------------------------------------------
     def _prepare_call(self, trainable, frozen, bufs):
         if self._sharded_params:
@@ -85,14 +95,16 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         # analog — a single device_put onto the mesh)
         for coll in (trainable, frozen, bufs):
             for p in coll.values():
-                p._value = jax.device_put(p._value, self._param_sharding(p))
+                p._value = jax.device_put(self._hostify(p._value),
+                                          self._param_sharding(p))
         self._sharded_params = True
 
     def _place_batch(self, batch):
         out = []
         for i, b in enumerate(batch):
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
-            out.append(jax.device_put(v, self._batch_sharding(i, v.ndim)))
+            out.append(jax.device_put(self._hostify(v),
+                                      self._batch_sharding(i, v.ndim)))
         return tuple(out)
 
     def _init_opt_state(self, t_items):
@@ -108,7 +120,8 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                 same_shape = tuple(np.shape(sval)) == tuple(p._value.shape)
                 sh = psh if same_shape else repl
                 slots[sname] = sh
-                self._opt_state[k][sname] = jax.device_put(sval, sh)
+                self._opt_state[k][sname] = jax.device_put(
+                    self._hostify(sval), sh)
             self._slot_shardings[k] = slots
 
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):

@@ -33,11 +33,25 @@ __all__ = [
 
 
 class _RNG(threading.local):
+    """Global RNG state. The base key is created LAZILY — building it
+    at import would initialize the XLA backend, which must not happen
+    before jax.distributed.initialize() in multi-process runs."""
+
     def __init__(self):
-        self.base = jax.random.key(0)
+        self._base = None
         self.counter = 0
         self.traced_key = None  # pushed by the jit harness during tracing
         self.trace_counter = 0
+
+    @property
+    def base(self):
+        if self._base is None:
+            self._base = jax.random.key(0)
+        return self._base
+
+    @base.setter
+    def base(self, v):
+        self._base = v
 
 
 _rng = _RNG()

@@ -1,0 +1,48 @@
+"""Worker script for the subprocess distributed harness (reference:
+test_dist_base.py TestDistRunnerBase.run_trainer — each rank trains
+the same model and reports per-step losses for the parent to compare).
+
+Runs standalone: reads the PADDLE_* env contract (absent = 1-process),
+trains a tiny data-parallel GPT over the global device mesh, writes
+per-rank losses as JSON to <out_prefix>.rank<r>.
+"""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.optimizer as optim  # noqa: E402
+from paddle_tpu.distributed import (build_mesh, get_rank,  # noqa: E402
+                                    init_parallel_env, set_mesh)
+from paddle_tpu.jit.distributed import (  # noqa: E402
+    DistributedTrainStepCompiler)
+from paddle_tpu.text.models.gpt import (GPTConfig,  # noqa: E402
+                                        GPTForCausalLM)
+
+
+def main(out_prefix):
+    init_parallel_env()
+    paddle.seed(0)
+    mesh = build_mesh({"dp": -1})
+    set_mesh(mesh)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, ffn_hidden=64, max_seq_len=16,
+                    remat=False, use_flash_attention=False, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = optim.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = DistributedTrainStepCompiler(model, opt, mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype(np.int32))
+    losses = [float(step(ids, ids).item()) for _ in range(3)]
+    with open(f"{out_prefix}.rank{get_rank()}", "w") as f:
+        json.dump(losses, f)
+    print(f"rank {get_rank()} losses {losses}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
