@@ -14,6 +14,8 @@ generated onto VarBase by op_function_generator.cc:388).
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -316,10 +318,44 @@ class Tensor:
     def __setitem__(self, idx, value):
         from .. import ops
 
-        if isinstance(value, Tensor):
-            value = value._value
-        idx = ops.manipulation._convert_index(idx)
-        self._value = self._value.at[idx].set(jnp.asarray(value, dtype=self.dtype))
+        cidx = ops.manipulation._convert_index(idx)
+        vt = value if isinstance(value, Tensor) else None
+        in_graph = self._node is not None or (
+            vt is not None and vt._node is not None)
+        requires = engine.is_grad_enabled() and not engine.in_trace_mode() and (
+            in_graph or not self.stop_gradient
+            or (vt is not None and not vt.stop_gradient))
+        if not requires:
+            v = vt._value if vt is not None else value
+            self._value = self._value.at[cidx].set(
+                jnp.asarray(v, dtype=self.dtype))
+            return
+        if self._node is None and not self.stop_gradient:
+            raise RuntimeError(
+                "a leaf Tensor that requires grad is being written "
+                "in-place (x[idx] = v); use x.detach() or no_grad() "
+                "(reference: set_value_op autograd semantics)")
+        # in-place write on a non-leaf in a live graph: record a
+        # set_value op. The node must see the PRE-mutation producer, so
+        # snapshot the old (_value, _node) into a detached alias that the
+        # tape keeps alive; `self` becomes the op's output.
+        pre = Tensor(self._value, stop_gradient=self.stop_gradient,
+                     _internal=True)
+        pre._node = self._node
+        pre._out_index = self._out_index
+
+        def _k(x, v):
+            return x.at[cidx].set(jnp.asarray(v).astype(x.dtype))
+
+        out = engine.apply_op(
+            "set_value", _k, pre,
+            vt if vt is not None else jnp.asarray(value, dtype=self.dtype))
+        self._value = out._value
+        self._node = out._node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+        if out._node is not None:
+            out._node.out_refs[out._out_index] = weakref.ref(self)
 
     def __iter__(self):
         for i in range(len(self)):

@@ -64,8 +64,12 @@ def _collect_layers(func, args):
                     add(cell.cell_contents)
                 except ValueError:
                     pass
-        for v in getattr(func, "__globals__", {}).values() if False else []:
-            pass
+        code = getattr(func, "__code__", None)
+        glb = getattr(func, "__globals__", {})
+        if code is not None:
+            for name in code.co_names:
+                if name in glb:
+                    add(glb[name])
     for a in args:
         add(a)
     return layers
@@ -121,18 +125,37 @@ class StaticFunction:
             entry = self._build(target, params, args_treedef, tensor_pos,
                                 static_leaves)
             self._compiled[key] = entry
-        jfn = entry
-        pvals = [p._value for p in params]
-        avals = [flat_args[i]._value for i in tensor_pos]
+        jfn, box = entry
+        arg_ts = [flat_args[i] for i in tensor_pos]
         rngc = jnp.asarray(_random._rng.counter, jnp.uint32)
-        out_vals, new_buf_vals, out_treedef_box = jfn(pvals, avals, rngc)
+        requires = engine.is_grad_enabled() and not engine.in_trace_mode() \
+            and (any(not p.stop_gradient for p in params)
+                 or any(not t.stop_gradient for t in arg_ts))
+        if requires:
+            # differentiable boundary: the compiled forward is one tape
+            # op, so loss.backward() after a @to_static forward flows
+            # grads into params/inputs (reference: ProgramTranslator
+            # builds the backward program for the whole block)
+            def kernel(pv, av, rc):
+                out_vals, new_bufs, _ = jfn(pv, av, rc)
+                return tuple(out_vals), tuple(new_bufs)
+
+            outs, buf_outs = engine.apply_op(
+                "run_program", kernel, list(params), arg_ts, rngc)
+            _random._rng.counter += 1
+            for (buf, _), nv in zip(box["buf_refs"], buf_outs):
+                buf._value = nv._value
+            return tree_util.tree_unflatten(box["treedef"], list(outs))
+        pvals = [p._value for p in params]
+        avals = [t._value for t in arg_ts]
+        out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
         _random._rng.counter += 1
         # commit buffer updates (BatchNorm stats)
-        for (buf, _), nv in zip(out_treedef_box["buf_refs"], new_buf_vals):
+        for (buf, _), nv in zip(box["buf_refs"], new_buf_vals):
             buf._value = nv
         flat_out = [Tensor(v, stop_gradient=True, _internal=True)
                     for v in out_vals]
-        return tree_util.tree_unflatten(out_treedef_box["treedef"], flat_out)
+        return tree_util.tree_unflatten(box["treedef"], flat_out)
 
     def _build(self, target, params, args_treedef, tensor_pos,
                static_leaves):
@@ -171,11 +194,7 @@ class StaticFunction:
                             p._value = sv
                     _random.pop_traced_key(prev_key)
 
-        def call(pvals, avals, rngc):
-            out_vals, new_bufs, _ = jfn(pvals, avals, rngc)
-            return out_vals, new_bufs, box
-
-        return call
+        return jfn, box
 
     def concrete_program(self):
         return None

@@ -46,7 +46,10 @@ class _RNG(threading.local):
     @property
     def base(self):
         if self._base is None:
-            self._base = jax.random.key(0)
+            # must stay concrete even when first touched inside a trace
+            # (a cached tracer would escape and poison later eager calls)
+            with jax.ensure_compile_time_eval():
+                self._base = jax.random.key(0)
         return self._base
 
     @base.setter

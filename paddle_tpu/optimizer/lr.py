@@ -54,9 +54,11 @@ class NoamDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        step = max(self.last_epoch, 1)
-        return (self.base_lr * self.d_model ** -0.5
-                * min(step ** -0.5, step * self.warmup_steps ** -1.5))
+        # reference lr.py NoamDecay: at epoch 0 the linear-warmup term is
+        # 0, so the scheduled lr is 0 (not a clamped step=1 value)
+        a = 1.0 if self.last_epoch == 0 else self.last_epoch ** -0.5
+        b = self.warmup_steps ** -1.5 * self.last_epoch
+        return self.base_lr * self.d_model ** -0.5 * min(a, b)
 
 
 class ExponentialDecay(LRScheduler):

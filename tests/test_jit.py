@@ -94,7 +94,10 @@ def test_jit_save_load(tmp_path):
 
     net = nn.Linear(2, 2)
     path = str(tmp_path / "model")
-    jit.save(net, path)
+    jit.save(net, path, input_spec=[jit.InputSpec([None, 2], "float32")])
     loaded = jit.load(path)
     sd = loaded.state_dict()
     np.testing.assert_allclose(sd["weight"].numpy(), net.weight.numpy())
+    x = paddle.rand([3, 2])
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               rtol=1e-6)
