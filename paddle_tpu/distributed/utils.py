@@ -1,13 +1,23 @@
 """MoE expert-parallel primitives (reference:
 python/paddle/distributed/utils.py global_scatter:57 / global_gather:179
-over operators/collective/global_scatter_op.cc).
+over operators/collective/global_scatter_op.cc / global_gather_op.cc).
 
-TPU-native: token routing is an all_to_all over the expert-parallel
-mesh axis inside compiled steps; eager single-controller keeps the
-global token tensor and permutes locally."""
+TPU-native: the reference routes variable-length token runs with NCCL
+send/recv driven by per-expert counts — dynamic shapes, which XLA
+rejects. Here routing is a static-capacity `lax.all_to_all` over the
+expert-parallel mesh axis inside compiled/shard_map regions: x is laid
+out as [world * n_local_expert * capacity, d] rows grouped by
+destination rank, and the all_to_all exchanges equal-size blocks over
+ICI. The high-level MoELayer
+(`paddle_tpu.incubate.distributed.models.moe`) reaches the same
+collectives via GSPMD-partitioned dispatch einsums. In eager
+single-controller mode the token tensor is already global, so routing
+is the identity."""
 from __future__ import annotations
 
 import numpy as np
+import jax
+from jax import lax
 import jax.numpy as jnp
 
 from ..core.engine import apply_op
@@ -20,15 +30,54 @@ def _k_identity(v):
     return v + 0
 
 
-def global_scatter(x, local_count, global_count, group=None,
+def _axis_names(group):
+    from .collective import _axis_names as an
+
+    return an(group)
+
+
+def _in_collective_trace(axes):
+    from .collective import _in_collective_trace as ict
+
+    return ict(axes)
+
+
+def _k_all_to_all_rows(v, axis):
+    """Exchange equal row-blocks across the `axis` ranks: view x as
+    [world, rows/world, d], all_to_all dim 0, flatten back."""
+    n = lax.psum(1, axis)
+    rows = v.shape[0]
+    if rows % n:
+        raise ValueError(
+            f"global_scatter/gather: {rows} rows not divisible by "
+            f"{n} ranks — pad to a static per-rank capacity first")
+    blocks = v.reshape((n, rows // n) + v.shape[1:])
+    out = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0,
+                         tiled=False)
+    return out.reshape(v.shape)
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
                    use_calc_stream=True):
-    """Route rows of x to experts. Single-controller: the token tensor is
-    already global, so routing is the identity here; the expert-parallel
-    all_to_all happens inside compiled steps (collective.alltoall over
-    the 'ep' axis)."""
+    """Route rows of x to the expert-parallel ranks.
+
+    In a shard_map/compiled trace over an expert axis this is a real
+    `lax.all_to_all` block exchange (counts are implied by the static
+    capacity layout). Eager single-controller: the token tensor is
+    global already, so routing is the identity.
+    """
+    axes = _axis_names(group)
+    if _in_collective_trace(axes):
+        return apply_op("global_scatter", _k_all_to_all_rows, x,
+                        axis=axes[0])
     return apply_op("global_scatter", _k_identity, x)
 
 
-def global_gather(x, local_count, global_count, group=None,
+def global_gather(x, local_count=None, global_count=None, group=None,
                   use_calc_stream=True):
+    """Inverse routing (same symmetric block all_to_all)."""
+    axes = _axis_names(group)
+    if _in_collective_trace(axes):
+        return apply_op("global_gather", _k_all_to_all_rows, x,
+                        axis=axes[0])
     return apply_op("global_gather", _k_identity, x)

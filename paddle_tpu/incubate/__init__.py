@@ -1,3 +1,4 @@
 """paddle.incubate (reference: python/paddle/incubate/)."""
 from . import nn
 from . import autograd
+from . import distributed

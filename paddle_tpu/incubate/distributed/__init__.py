@@ -1,0 +1,2 @@
+"""namespace (mirrors paddle.incubate.distributed)."""
+from . import models

@@ -1,0 +1,2 @@
+"""namespace (mirrors paddle.incubate.distributed.models)."""
+from . import moe
