@@ -96,6 +96,16 @@ class enable_grad:
         return False
 
 
+_trace_exit_hooks = []
+
+
+def register_trace_exit_hook(fn):
+    """Called whenever the outermost trace_mode exits (normally or via
+    exception) — used to drop trace-scoped state (e.g. pending p2p
+    sends) so tracers never leak across traces."""
+    _trace_exit_hooks.append(fn)
+
+
 class trace_mode:
     """Active while tracing a function for jit; disables the tape."""
 
@@ -105,6 +115,9 @@ class trace_mode:
 
     def __exit__(self, *exc):
         _state.trace_mode -= 1
+        if _state.trace_mode == 0:
+            for fn in _trace_exit_hooks:
+                fn()
         return False
 
 

@@ -57,6 +57,21 @@ def _k_all_to_all_rows(v, axis):
     return out.reshape(v.shape)
 
 
+def _expert_axis(axes):
+    """Pick the expert-parallel axis: prefer 'ep', then 'mp'; a bare
+    multi-axis world group (group=None) must NOT silently route over
+    'dp' — that would exchange tokens across data-parallel replicas."""
+    for preferred in ("ep", "mp"):
+        if preferred in axes:
+            return preferred
+    if len(axes) == 1:
+        return axes[0]
+    raise ValueError(
+        "global_scatter/global_gather: cannot infer the expert-parallel "
+        f"axis from group axes {axes} — pass a group created over the "
+        "'ep' (or 'mp') mesh axis")
+
+
 def global_scatter(x, local_count=None, global_count=None, group=None,
                    use_calc_stream=True):
     """Route rows of x to the expert-parallel ranks.
@@ -69,7 +84,7 @@ def global_scatter(x, local_count=None, global_count=None, group=None,
     axes = _axis_names(group)
     if _in_collective_trace(axes):
         return apply_op("global_scatter", _k_all_to_all_rows, x,
-                        axis=axes[0])
+                        axis=_expert_axis(axes))
     return apply_op("global_scatter", _k_identity, x)
 
 
@@ -79,5 +94,5 @@ def global_gather(x, local_count=None, global_count=None, group=None,
     axes = _axis_names(group)
     if _in_collective_trace(axes):
         return apply_op("global_gather", _k_all_to_all_rows, x,
-                        axis=axes[0])
+                        axis=_expert_axis(axes))
     return apply_op("global_gather", _k_identity, x)

@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .....core.engine import apply_op
+import weakref
+
+from .....core.engine import apply_op, register_trace_exit_hook
 from .....core.tensor import Parameter
 from .....nn.layer.layers import Layer
 from .....ops import random as _random
@@ -35,18 +37,29 @@ from .....distributed import mesh as mesh_mod
 
 __all__ = ["MoELayer", "TopKGate", "moe_dispatch_combine"]
 
+_live_moe_layers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _drop_trace_scoped_aux():
+    for layer in _live_moe_layers:
+        layer.aux_loss = None
+
+
+register_trace_exit_hook(_drop_trace_scoped_aux)
+
 
 def _constrain(x, spec):
     mesh = mesh_mod.get_mesh()
     if mesh is None:
         return x
-    names = tuple(a if (a is None or a in mesh.shape) else None
-                  for a in spec)
-    if all(n is None for n in names):
+    from .....jit.distributed import filter_spec
+
+    fspec = filter_spec(P(*spec), mesh)
+    if all(n is None for n in fspec):
         return x
     try:
         return jax.lax.with_sharding_constraint(
-            x, jax.sharding.NamedSharding(mesh, P(*names)))
+            x, jax.sharding.NamedSharding(mesh, fspec))
     except (ValueError, TypeError):
         return x
 
@@ -81,8 +94,8 @@ def _top2_gating(logits, capacity):
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
-    p1 = jnp.sum(pos1 * mask1, axis=-1)
-    p2 = jnp.sum(pos2 * mask2, axis=-1)
+    p1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)
+    p2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
     oh1 = jax.nn.one_hot(p1, capacity, dtype=gates.dtype)
     oh2 = jax.nn.one_hot(p2, capacity, dtype=gates.dtype)
     combine = (g1[:, None, None] * mask1[:, :, None] * oh1[:, None, :]
@@ -102,7 +115,7 @@ def _top1_gating(logits, capacity):
     pos1 = jnp.cumsum(mask1, axis=0) - mask1
     mask1 = mask1 * (pos1 < capacity)
     g1 = jnp.sum(gates * mask1, axis=-1)
-    p1 = jnp.sum(pos1 * mask1, axis=-1)
+    p1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)
     oh1 = jax.nn.one_hot(p1, capacity, dtype=gates.dtype)
     combine = g1[:, None, None] * mask1[:, :, None] * oh1[:, None, :]
     return combine, combine > 0.0, aux_loss
@@ -126,7 +139,8 @@ def _k_moe_ffn(x, gate_w, w1, b1, w2, b2, top_k, capacity):
     """Full MoE FFN block: [B,S,H] -> ([B,S,H], aux_loss)."""
     b, s, h = x.shape
     xt = x.reshape(b * s, h)
-    logits = (xt @ gate_w.astype(xt.dtype)).astype(jnp.float32)
+    # gating math stays f32 even under bf16 training (GShard recipe)
+    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
     gate = _top2_gating if top_k == 2 else _top1_gating
     combine, dispatch, aux_loss = gate(logits, capacity)
 
@@ -146,6 +160,9 @@ class TopKGate(Layer):
 
     def __init__(self, d_model, num_experts, top_k=2):
         super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2 (GShard gating), "
+                             f"got {top_k}")
         self.top_k = top_k
         self.num_experts = num_experts
         k = _random.next_key()
@@ -169,7 +186,10 @@ class MoELayer(Layer):
 
     After each forward, `self.aux_loss` holds the load-balancing loss
     tensor (differentiable) — add `aux_weight * layer.aux_loss` to the
-    training loss.
+    training loss *within the same forward/loss computation*. The
+    attribute is reset to None when a compiled trace exits, so a tracer
+    can never leak onto the long-lived layer (reading it outside the
+    step yields a clear None rather than an escaped-tracer error).
     """
 
     def __init__(self, d_model, d_hidden, num_experts, top_k=2,
@@ -200,6 +220,7 @@ class MoELayer(Layer):
             p.dist_spec = P(*((expert_axis,) + (None,) * (p._value.ndim - 1)))
             self.add_parameter(name, p)
         self.aux_loss = None
+        _live_moe_layers.add(self)
 
     def expert_capacity(self, num_tokens):
         return max(4, int(math.ceil(

@@ -153,3 +153,51 @@ def test_send_recv_pair_in_shard_map_and_eager_raise():
         dist.send(paddle.ones([2]), dst=1)
     with pytest.raises(NotImplementedError):
         dist.recv(paddle.ones([2]), src=0)
+
+
+def test_send_recv_traced_pair_lowers_to_single_edge_permute():
+    """send(x, dst=2) + recv(buf, src=0) inside shard_map = one
+    collective-permute edge: rank 2 receives rank 0's shard, all other
+    ranks see zeros."""
+    from jax import shard_map
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.mesh import new_group_for_axes
+
+    mesh = build_mesh({"pp": 8})
+    set_mesh(mesh)
+    g = new_group_for_axes(("pp",))
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+
+    def body(xs):
+        dist.send(xs, dst=2, group=g)
+        out = dist.recv(jnp.zeros_like(xs), src=0, group=g)
+        return out._value if hasattr(out, "_value") else out
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("pp"),),
+                  out_specs=P("pp"))(jnp.asarray(x))
+    y = np.asarray(y)
+    np.testing.assert_array_equal(y[2], x[0])  # rank 2 got rank 0's shard
+    mask = np.ones(8, bool)
+    mask[2] = False
+    assert np.all(y[mask] == 0.0)
+
+
+def test_send_twice_without_recv_raises():
+    from jax import shard_map
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.collective import _clear_pending_sends
+    from paddle_tpu.distributed.mesh import new_group_for_axes
+
+    mesh = build_mesh({"pp": 8})
+    set_mesh(mesh)
+    g = new_group_for_axes(("pp",))
+
+    def body(xs):
+        dist.send(xs, dst=1, group=g)
+        dist.send(xs, dst=2, group=g)
+        return xs
+
+    with pytest.raises(Exception, match="already outstanding"):
+        shard_map(body, mesh=mesh, in_specs=(P("pp"),),
+                  out_specs=P("pp"))(jnp.ones((8, 2), jnp.float32))
+    _clear_pending_sends()
