@@ -117,7 +117,12 @@ class trace_mode:
         _state.trace_mode -= 1
         if _state.trace_mode == 0:
             for fn in _trace_exit_hooks:
-                fn()
+                try:
+                    fn()
+                except Exception:
+                    # a failing hook must not mask the trace's own
+                    # exception or starve the remaining hooks
+                    pass
         return False
 
 

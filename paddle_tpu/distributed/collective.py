@@ -112,6 +112,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
         out = apply_op("c_broadcast", _k, tensor)
         tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_index = out._out_index
         return tensor
     return tensor
 
@@ -192,14 +194,18 @@ from ..core.engine import register_trace_exit_hook as _reg_hook  # noqa: E402
 _reg_hook(_clear_pending_sends)
 
 
-def _entry_is_live(sent):
-    """A pending send left behind by an aborted trace holds a dead
-    tracer; probe it so a stale entry can't poison the axis forever or
-    feed a dead tracer into ppermute."""
+def _entry_is_current(probe, ax):
+    """Each pending send stores an axis_index tracer from its trace as
+    a liveness probe — unlike the payload (which may be a concrete
+    value closed over by the trace), the tracer is tied to exactly one
+    trace. An entry is current iff its probe belongs to the SAME trace
+    as a freshly-minted axis_index, so a stale entry from an aborted
+    trace can't poison the axis forever or be silently received by a
+    later trace."""
     try:
-        v = sent._value if isinstance(sent, Tensor) else sent
-        _ = v + 0
-        return True
+        cur = lax.axis_index(ax)
+        return (getattr(probe, "_trace", None) is
+                getattr(cur, "_trace", object()))
     except Exception:
         return False
 
@@ -221,14 +227,14 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if _in_collective_trace(axes):
         ax = axes[0]
         if ax in _pending_sends:
-            if _entry_is_live(_pending_sends[ax][1]):
+            if _entry_is_current(_pending_sends[ax][2], ax):
                 raise RuntimeError(
                     "paddle.distributed.send: a send on axis "
                     f"'{ax}' is already outstanding — SPMD tracing "
                     "supports one send/recv pair in flight per axis; "
                     "for exchanges use lax.ppermute or alltoall")
             del _pending_sends[ax]  # stale entry from an aborted trace
-        _pending_sends[ax] = (int(dst), tensor)
+        _pending_sends[ax] = (int(dst), tensor, lax.axis_index(ax))
         return tensor
     raise NotImplementedError(
         "paddle.distributed.send: eager point-to-point is not supported "
@@ -250,8 +256,8 @@ def recv(tensor, src=0, group=None, sync_op=True):
                 "paddle.distributed.recv: no matching send() recorded on "
                 f"axis {ax} — send/recv must be called as a pair "
                 "within one traced step")
-        dst, sent = _pending_sends.pop(ax)
-        if not _entry_is_live(sent):
+        dst, sent, probe = _pending_sends.pop(ax)
+        if not _entry_is_current(probe, ax):
             raise RuntimeError(
                 "paddle.distributed.recv: the pending send on axis "
                 f"'{ax}' is stale (left by an aborted trace) — "

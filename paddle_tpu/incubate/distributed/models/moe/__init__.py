@@ -41,8 +41,13 @@ _live_moe_layers: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def _drop_trace_scoped_aux():
+    # only clear leaked tracers — a concrete aux_loss from an eager
+    # forward must survive unrelated compilations
     for layer in _live_moe_layers:
-        layer.aux_loss = None
+        aux = layer.aux_loss
+        val = getattr(aux, "_value", aux)
+        if isinstance(val, jax.core.Tracer):
+            layer.aux_loss = None
 
 
 register_trace_exit_hook(_drop_trace_scoped_aux)

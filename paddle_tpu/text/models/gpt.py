@@ -47,6 +47,10 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     use_flash_attention: bool = True
+    # sequence-parallel ring attention over the 'sp' mesh axis (KV
+    # blocks rotate via collective-permute; exact, O(S/sp) memory per
+    # chip). Engages only when the live mesh has sp > 1.
+    use_ring_attention: bool = False
     remat: bool = True  # jax.checkpoint each block (recompute analog)
     # explicit GPipe schedule over the 'pp' mesh axis: num_layers is
     # cut into pp_num_stages stages and the batch into
@@ -71,13 +75,20 @@ def _maybe_constrain(x, spec):
         return x
 
 
-def _attention(q, k, v, n_head, use_flash):
+def _attention(q, k, v, n_head, use_flash, use_ring=False):
     b, s, h = q.shape
     d = h // n_head
     q = q.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
     scale = 1.0 / math.sqrt(d)
+    if use_ring:
+        # ring_attention owns ALL fallback logic (no mesh / sp==1 /
+        # indivisible seq -> exact dense attention)
+        from ...incubate.nn.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, causal=True, sm_scale=scale)
+        return out.transpose(0, 2, 1, 3).reshape(b, s, h)
     if use_flash:
         try:
             from ...incubate.nn.attention_pallas import _flash_fwd_impl  # noqa
@@ -112,7 +123,7 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _block(x, bp, key, n_head, eps, use_flash, dropout):
+def _block(x, bp, key, n_head, eps, use_flash, dropout, use_ring=False):
     """One transformer block; bp holds this layer's parameter slices."""
     k1 = k2 = None
     if key is not None and dropout > 0.0:
@@ -121,7 +132,7 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout):
     qkv = h @ bp["qkv_w"] + bp["qkv_b"]
     qkv = _maybe_constrain(qkv, ("dp", "sp", "mp"))
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    attn = _attention(q, k, v, n_head, use_flash)
+    attn = _attention(q, k, v, n_head, use_flash, use_ring)
     attn = attn @ bp["proj_w"] + bp["proj_b"]
     attn = _dropout(attn, dropout, k1)
     x = x + _maybe_constrain(attn, ("dp", "sp", None))
@@ -135,7 +146,8 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout):
 
 
 def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
-                   dropout=0.0, key=None, pp_stages=0, pp_microbatches=0):
+                   dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
+                   use_ring=False):
     x = jnp.take(params["wte"], ids, axis=0)
     pos = jnp.arange(ids.shape[1])
     x = x + jnp.take(params["wpe"], pos, axis=0)
@@ -153,11 +165,11 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
         if remat:
             fn = jax.checkpoint(
                 lambda c, lp, lk: _block(c, lp, lk, n_head, eps, use_flash,
-                                         dropout))
+                                         dropout, use_ring))
             out = fn(carry, layer_params, lkey)
         else:
             out = _block(carry, layer_params, lkey, n_head, eps, use_flash,
-                         dropout)
+                         dropout, use_ring)
         return out, None
 
     if pp_stages > 1 and pp_microbatches > 1:
@@ -194,11 +206,13 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
 
 
 def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
-                dropout=0.0, key=None, pp_stages=0, pp_microbatches=0):
+                dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
+                use_ring=False):
     """Causal-LM loss with the standard next-token shift: position t
     predicts labels[t+1] (HF convention — pass labels=input_ids)."""
     logits = _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
-                            dropout, key, pp_stages, pp_microbatches)
+                            dropout, key, pp_stages, pp_microbatches,
+                            use_ring)
     lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = labels[:, 1:]
     picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
@@ -276,7 +290,8 @@ class GPTModel(Layer):
                         eps=c.layer_norm_eps,
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
-                        pp_microbatches=c.pp_microbatches)
+                        pp_microbatches=c.pp_microbatches,
+                        use_ring=c.use_ring_attention)
 
 
 class GPTForCausalLM(Layer):
@@ -296,7 +311,8 @@ class GPTForCausalLM(Layer):
                         eps=c.layer_norm_eps,
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
-                        pp_microbatches=c.pp_microbatches)
+                        pp_microbatches=c.pp_microbatches,
+                        use_ring=c.use_ring_attention)
 
 
 def gpt2_small(**kw):
