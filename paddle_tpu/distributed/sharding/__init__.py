@@ -13,25 +13,72 @@ from .. import mesh as mesh_mod
 from jax.sharding import PartitionSpec
 
 
+def _sharding_spec_for(shape, shard_n):
+    """'sharding'-axis PartitionSpec on the first divisible dim."""
+    for dim, s in enumerate(tuple(shape)):
+        if s % shard_n == 0:
+            axes = [None] * len(shape)
+            axes[dim] = "sharding"
+            return PartitionSpec(*axes)
+    return None
+
+
+def _compose_sharding(spec, shape, shard_n):
+    """Add the 'sharding' axis to an existing spec (TP/EP/PP-tagged
+    param) on the first free, divisible dim — hybrid TP+ZeRO-3 must
+    shard the big Megatron/MoE weights too, not skip them."""
+    names = list(spec) + [None] * (len(shape) - len(spec))
+    for dim, s in enumerate(tuple(shape)):
+        cur = names[dim]
+        if cur is None and s % shard_n == 0:
+            names[dim] = "sharding"
+            return PartitionSpec(*names)
+        if cur is not None:
+            # already sharded on this dim; a further divisible split
+            # composes as a tuple axis
+            axes = cur if isinstance(cur, (tuple, list)) else (cur,)
+            if "sharding" not in axes:
+                continue
+    return spec  # no free divisible dim — leave as-is
+
+
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            group=None, offload=False, sync_buffers=False,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
                            sync_comm=False):
-    """Tag every parameter for sharding along the 'sharding' axis on its
-    largest divisible dim (stage 2/3 analog); jit harness applies it."""
+    """ZeRO via GSPMD sharding specs over the 'sharding' mesh axis.
+
+    Levels (reference: sharding_stage2.py:43 / sharding_stage3.py:51):
+    - "os"     (stage 1): optimizer states sharded; params and merged
+      grads replicated.
+    - "os_g"   (stage 2): optimizer states AND grad-merge buffers
+      sharded (slot_dist_spec / accum_dist_spec); params replicated.
+    - "p_g_os" (stage 3): params themselves sharded at rest
+      (dist_spec) — XLA all-gathers each layer's params where consumed
+      inside the step (with remat this is the stage-3 pre/post-layer
+      gather, derived by the compiler instead of Python hooks) and
+      reduce-scatters grads back to the owning shard. Params already
+      carrying a TP/EP spec get 'sharding' composed onto a free dim.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"unknown sharding level {level!r}")
     mesh = mesh_mod.get_mesh()
     shard_n = mesh.shape.get("sharding", 1) if mesh is not None else 1
     for _, p in model.named_parameters():
-        spec = None
-        if shard_n > 1 and level in ("os_g", "p_g_os"):
-            shape = tuple(p.shape)
-            for dim, s in enumerate(shape):
-                if s % shard_n == 0:
-                    axes = [None] * len(shape)
-                    axes[dim] = "sharding"
-                    spec = PartitionSpec(*axes)
-                    break
-        p.dist_spec = spec
+        spec = _sharding_spec_for(p.shape, shard_n) if shard_n > 1 else None
+        if level == "p_g_os":
+            existing = getattr(p, "dist_spec", None)
+            if existing is None:
+                p.dist_spec = spec
+            elif shard_n > 1:
+                p.dist_spec = _compose_sharding(existing, p.shape, shard_n)
+        else:
+            # stage 1/2: params stay replicated (keep any TP/PP spec the
+            # model set); optimizer slots shard, and for stage 2 the
+            # grad-merge buffers shard too
+            p.slot_dist_spec = spec
+            if level == "os_g":
+                p.accum_dist_spec = spec
     return model, optimizer, scaler
 
 

@@ -70,8 +70,8 @@ def ring_attention_shard(q, k, v, axis_name="sp", causal=True,
     q_off = my * s_local
     perm = [(j, (j + 1) % nsteps) for j in range(nsteps)]
 
-    def step(carry, i):
-        acc, m, l, k_blk, v_blk = carry
+    def combine(carry, i, k_blk, v_blk):
+        acc, m, l = carry
         # this block originated at rank (my - i) mod sp
         k_off = ((my - i) % nsteps) * s_local
         m_cur, l_cur, pv = _chunk_attn_partial(
@@ -80,18 +80,27 @@ def ring_attention_shard(q, k, v, axis_name="sp", causal=True,
         m_new = jnp.maximum(m, m_cur)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_cur - m_new)
-        l = l * alpha + l_cur * beta
-        acc = acc * alpha + pv * beta
-        # rotate KV to the next neighbor (ICI ring)
+        return (acc * alpha + pv * beta, m_new, l * alpha + l_cur * beta)
+
+    def step(carry, i):
+        acc, m, l, k_blk, v_blk = carry
+        acc, m, l = combine((acc, m, l), i, k_blk, v_blk)
+        # rotate KV to the next neighbor (ICI ring); the permute's input
+        # doesn't depend on this step's matmuls, so XLA overlaps them
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (acc, m_new, l, k_blk, v_blk), None
+        return (acc, m, l, k_blk, v_blk), None
 
     acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
     m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
-    (acc, m, l, _, _), _ = lax.scan(
-        step, (acc0, m0, l0, k, v), jnp.arange(nsteps))
+    carry = (acc0, m0, l0, k, v)
+    if nsteps > 1:
+        # scan the first nsteps-1 blocks (each ends with a rotation)…
+        carry, _ = lax.scan(step, carry, jnp.arange(nsteps - 1))
+    # …and fold in the final block without a wasted trailing permute
+    acc, m, l, k_blk, v_blk = carry
+    acc, m, l = combine((acc, m, l), nsteps - 1, k_blk, v_blk)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 

@@ -431,11 +431,19 @@ class TrainStepCompiler:
         loss = step(x, y)          # updates model params in place
     """
 
-    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+    def __init__(self, model, optimizer, loss_fn=None, donate=True,
+                 accumulate_steps=1):
+        """accumulate_steps > 1 enables gradient merge (reference:
+        fleet gradient_merge_optimizer / RecomputeOptimizer micro-batch
+        accumulation): grads from k consecutive calls accumulate in a
+        donated buffer sharded like the parameter, and the optimizer
+        applies the averaged gradient on every k-th call."""
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
         self._donate = donate
+        self._accum_steps = max(1, int(accumulate_steps))
+        self._accum_state = None
         self._compiled = None
         self._names = None
         self._opt_state = None
@@ -457,7 +465,7 @@ class TrainStepCompiler:
                      for b in batch)
 
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
     def __call__(self, *batch):
@@ -472,20 +480,28 @@ class TrainStepCompiler:
         # host scalars (jit globalizes them under any mesh/process set)
         lr = np.float32(self._opt.get_lr())
         rngc = np.uint32(self._step)
-        new_p, new_opt, new_b, loss = self._compiled(
-            pvals, self._opt_state, fvals, bvals, avals, lr, rngc)
+        new_p, new_opt, new_acc, new_b, loss = self._compiled(
+            pvals, self._opt_state, self._accum_state, fvals, bvals,
+            avals, lr, rngc)
         self._opt_state = new_opt
+        self._accum_state = new_acc
         for k, p in trainable.items():
             p._value = new_p[k]
         for k, b in bufs.items():
             b._value = new_b[k]
         self._step += 1
-        self._opt._step_count += 1
+        if self._step % self._accum_steps == 0:
+            self._opt._step_count += 1
         return Tensor(loss, stop_gradient=True, _internal=True)
 
     def _init_opt_state(self, t_items):
         self._opt_state = self._opt.init_state(
             {k: p._value for k, p in t_items})
+        # gradient-merge accumulation buffers (zeros, param-shaped)
+        self._accum_state = (
+            {k: jnp.zeros(p._value.shape, jnp.float32)
+             for k, p in t_items}
+            if self._accum_steps > 1 else {})
 
     def _build(self, trainable, frozen, bufs, batch):
         model = self._model
@@ -534,11 +550,35 @@ class TrainStepCompiler:
                         obj._value = v
                     _random.pop_traced_key(prev_key)
 
-        def step_fn(pvals, opt_state, fvals, bvals, avals, lr, rngc):
+        k_merge = self._accum_steps
+
+        def step_fn(pvals, opt_state, accum, fvals, bvals, avals, lr,
+                    rngc):
             (loss, new_bvals), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(pvals, fvals, bvals, avals, rngc)
-            new_p, new_s = opt.apply_gradients(pvals, grads, opt_state, lr)
-            return new_p, new_s, new_bvals, loss
+            if k_merge <= 1:
+                new_p, new_s = opt.apply_gradients(pvals, grads,
+                                                   opt_state, lr)
+                return new_p, new_s, accum, new_bvals, loss
+            # gradient merge: accumulate; apply every k-th call
+            acc = {n: accum[n] + grads[n].astype(jnp.float32)
+                   for n in grads}
+
+            def _apply(_):
+                merged = {n: (acc[n] / k_merge).astype(grads[n].dtype)
+                          for n in acc}
+                new_p, new_s = opt.apply_gradients(pvals, merged,
+                                                   opt_state, lr)
+                zeros = {n: jnp.zeros_like(acc[n]) for n in acc}
+                return new_p, new_s, zeros
+
+            def _skip(_):
+                return pvals, opt_state, acc
+
+            do_apply = (rngc % np.uint32(k_merge)) == np.uint32(k_merge - 1)
+            new_p, new_s, new_acc = jax.lax.cond(do_apply, _apply, _skip,
+                                                 None)
+            return new_p, new_s, new_acc, new_bvals, loss
 
         self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
                                         batch)

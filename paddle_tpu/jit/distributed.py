@@ -57,15 +57,17 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
     """
 
     def __init__(self, model, optimizer, loss_fn=None, mesh=None,
-                 batch_specs=None, donate=True):
+                 batch_specs=None, donate=True, accumulate_steps=1):
         from ..distributed import mesh as mesh_mod
 
-        super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate)
+        super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate,
+                         accumulate_steps=accumulate_steps)
         self._mesh = mesh or mesh_mod.default_mesh()
         mesh_mod.set_mesh(self._mesh)  # activation constraints read this
         self._batch_specs = batch_specs
         self._sharded_params = False
         self._slot_shardings = None
+        self._accum_shardings = {}
 
     def _param_sharding(self, p):
         return NamedSharding(self._mesh,
@@ -107,14 +109,24 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                                       self._batch_sharding(i, v.ndim)))
         return tuple(out)
 
+    def _slot_sharding(self, p):
+        """Optimizer-state sharding: ZeRO stage 2 ('os_g') tags params
+        with `slot_dist_spec` (slots sharded, params replicated); stage
+        3 shards the param itself, which slots inherit."""
+        spec = getattr(p, "slot_dist_spec", None)
+        if spec is not None:
+            return NamedSharding(self._mesh, filter_spec(spec, self._mesh))
+        return self._param_sharding(p)
+
     def _init_opt_state(self, t_items):
         super()._init_opt_state(t_items)
         # shard optimizer slots like their parameters (ZeRO pattern when
         # 'sharding' specs are present)
         self._slot_shardings = {}
+        self._accum_shardings = {}
         repl = NamedSharding(self._mesh, P())
         for k, p in t_items:
-            psh = self._param_sharding(p)
+            psh = self._slot_sharding(p)
             slots = {}
             for sname, sval in self._opt_state[k].items():
                 same_shape = tuple(np.shape(sval)) == tuple(p._value.shape)
@@ -123,6 +135,18 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                 self._opt_state[k][sname] = jax.device_put(
                     self._hostify(sval), sh)
             self._slot_shardings[k] = slots
+        # gradient-merge buffers: stage 2 tags accum_dist_spec (sharded
+        # merged grads); otherwise they follow the param's own sharding
+        # (stage 3: sharded; plain runs: replicated)
+        for k, p in t_items:
+            if k in self._accum_state:
+                aspec = getattr(p, "accum_dist_spec", None)
+                sh = (NamedSharding(self._mesh,
+                                    filter_spec(aspec, self._mesh))
+                      if aspec is not None else self._param_sharding(p))
+                self._accum_shardings[k] = sh
+                self._accum_state[k] = jax.device_put(
+                    self._hostify(self._accum_state[k]), sh)
 
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
         mesh = self._mesh
@@ -136,9 +160,11 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         for i, b in enumerate(batch):
             v = b._value if isinstance(b, Tensor) else np.asarray(b)
             batch_sh.append(self._batch_sharding(i, np.ndim(v)))
-        in_shardings = (param_sh, self._slot_shardings, frozen_sh, buf_sh,
+        in_shardings = (param_sh, self._slot_shardings,
+                        self._accum_shardings, frozen_sh, buf_sh,
                         tuple(batch_sh), repl, repl)
-        out_shardings = (param_sh, self._slot_shardings, buf_sh, repl)
-        donate = (0, 1) if self._donate else ()
+        out_shardings = (param_sh, self._slot_shardings,
+                        self._accum_shardings, buf_sh, repl)
+        donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)

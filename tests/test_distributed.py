@@ -192,8 +192,16 @@ def test_group_sharded_tags_params():
     set_mesh(mesh)
     model = nn.Linear(8, 8)
     o = optim.Adam(learning_rate=1e-3, parameters=model.parameters())
+    # default level os_g (stage 2): optimizer slots shard, params stay
+    # replicated at rest
     model, o, _ = group_sharded_parallel(model, o)
-    assert model.weight.dist_spec is not None
+    assert model.weight.slot_dist_spec is not None
+    assert getattr(model.weight, "dist_spec", None) is None
+    # stage 3 (p_g_os): the parameter itself is sharded at rest
+    model3 = nn.Linear(8, 8)
+    o3 = optim.Adam(learning_rate=1e-3, parameters=model3.parameters())
+    model3, o3, _ = group_sharded_parallel(model3, o3, level="p_g_os")
+    assert model3.weight.dist_spec is not None
 
 
 def test_gpipe_schedule_parity_pp4():
