@@ -193,6 +193,18 @@ def set_input_cast_hook(fn):
     _input_cast_hook = fn
 
 
+# Static-graph op recorder, registered by paddle_tpu.static. When
+# enable_static() is on and an op consumes a static Variable, the hook
+# appends an OpRecord to the current Program and returns symbolic
+# Variables (LayerHelper.append_op analog) instead of executing.
+_static_record_hook = None
+
+
+def set_static_record_hook(fn):
+    global _static_record_hook
+    _static_record_hook = fn
+
+
 def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(e) for e in v)
@@ -231,6 +243,11 @@ def apply_op(name, fn, *args, **kwargs):
     pure jax function returning an array or a pytree of arrays.
     """
     from .tensor import Tensor
+
+    if _static_record_hook is not None:
+        rec = _static_record_hook(name, fn, args, kwargs)
+        if rec is not NotImplemented:
+            return rec
 
     flat_in, in_treedef = tree_util.tree_flatten(
         args, is_leaf=lambda x: x is None or _is_tensor(x)

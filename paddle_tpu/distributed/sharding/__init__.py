@@ -26,19 +26,17 @@ def _sharding_spec_for(shape, shard_n):
 def _compose_sharding(spec, shape, shard_n):
     """Add the 'sharding' axis to an existing spec (TP/EP/PP-tagged
     param) on the first free, divisible dim — hybrid TP+ZeRO-3 must
-    shard the big Megatron/MoE weights too, not skip them."""
+    shard the big Megatron/MoE weights too, not skip them. A spec that
+    already mentions 'sharding' is returned unchanged (idempotent)."""
     names = list(spec) + [None] * (len(shape) - len(spec))
+    for cur in names:
+        axes = cur if isinstance(cur, (tuple, list)) else (cur,)
+        if "sharding" in axes:
+            return spec
     for dim, s in enumerate(tuple(shape)):
-        cur = names[dim]
-        if cur is None and s % shard_n == 0:
+        if names[dim] is None and s % shard_n == 0:
             names[dim] = "sharding"
             return PartitionSpec(*names)
-        if cur is not None:
-            # already sharded on this dim; a further divisible split
-            # composes as a tuple axis
-            axes = cur if isinstance(cur, (tuple, list)) else (cur,)
-            if "sharding" not in axes:
-                continue
     return spec  # no free divisible dim — leave as-is
 
 

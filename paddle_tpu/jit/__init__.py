@@ -344,17 +344,30 @@ def save(layer, path, input_spec=None, **configs):
     if isinstance(owner, Layer) and was_training:
         owner.train()
 
+    write_saved_artifacts(
+        path, exported, params, bufs,
+        {"out_treedef": box["treedef"],
+         "input_spec": [(s.shape, str(s.dtype)) for s in input_spec],
+         "class": type(layer).__name__})
+
+
+def write_saved_artifacts(path, exported, params, buffers, meta):
+    """Single writer for the saved-model triple (.pdmodel serialized
+    StableHLO, .pdiparams params/buffers, .pdmeta pickle) — shared by
+    jit.save and static.save_inference_model so the on-disk contract
+    that jit.load/TranslatedLayer reads has exactly one producer."""
+    import os
+    import pickle
+
+    from .. import framework
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
-    framework.save({"params": {k: p for k, p in params.items()},
-                    "buffers": {k: b for k, b in bufs.items()}},
+    framework.save({"params": dict(params), "buffers": dict(buffers)},
                    path + ".pdiparams")
     with open(path + ".pdmeta", "wb") as f:
-        pickle.dump({"out_treedef": box["treedef"],
-                     "input_spec": [(s.shape, str(s.dtype))
-                                    for s in input_spec],
-                     "class": type(layer).__name__}, f)
+        pickle.dump(meta, f)
 
 
 class TranslatedLayer:

@@ -134,6 +134,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import _static_mode, _record_minimize
+        from ..static.graph import Variable
+
+        if _static_mode() and isinstance(loss, Variable):
+            # static graph: record the train spec; the Executor's
+            # compiled step computes grads + applies this optimizer
+            return _record_minimize(self, loss, parameter_list=parameters)
         loss.backward()
         self.step()
         return None, None

@@ -1,65 +1,42 @@
-"""paddle.static (reference: python/paddle/static/__init__.py,
-fluid/framework.py Program/Executor).
+"""paddle.static — the static-graph front end.
 
-TPU-native design: a static Program records layer calls as a traced
-closure; Executor.run compiles it with jax.jit (Program → XLA HLO).
-Round-1 scope: program_guard captures a build function lazily — the
-imperative dygraph + to_static path is the primary API; this module
-keeps source compatibility for static-graph-style user code.
+Parity target: python/paddle/static/__init__.py over
+fluid/framework.py (Program/Block/Variable), fluid/executor.py
+(Executor feed/fetch), fluid/backward.py:1413 (append_backward), and
+static/io.py (save/load_inference_model).
+
+TPU-native design (SURVEY §7 step 4): a Program records each op's pure
+jax kernel + Variable refs (graph.py); Executor.run REPLAYS the whole
+program inside one jax.jit — Program → XLA HLO, compiled once per feed
+signature, with feed/fetch as PJRT transfers. append_backward ≙
+jax.grad over the replayed loss (static autodiff without per-op grad
+descs); control flow (cond/while_loop) lowers to lax.cond /
+lax.while_loop.
 """
 from __future__ import annotations
 
 import threading
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..core import engine
 from ..jit import InputSpec
+from .graph import (Block, OpRecord, Program, StaticRecorder, Variable,
+                    cond, while_loop, replay_block)
+from . import nn  # noqa: F401  (paddle.static.nn namespace)
 
 __all__ = [
-    "Program", "program_guard", "default_main_program",
+    "Program", "Variable", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "CompiledProgram",
     "BuildStrategy", "ExecutionStrategy", "InputSpec", "name_scope",
     "save_inference_model", "load_inference_model", "gradients",
-    "append_backward",
+    "append_backward", "cond", "while_loop", "nn",
 ]
 
 _state = threading.local()
-
-
-class _FeedVar:
-    """Placeholder created by static.data inside a Program."""
-
-    def __init__(self, name, shape, dtype):
-        self.name = name
-        self.shape = shape
-        self.dtype = dtype
-        self.desc = self
-
-    def __repr__(self):
-        return f"FeedVar({self.name}, shape={self.shape})"
-
-
-class Program:
-    """Deferred-build graph: ops recorded as a Python build closure,
-    compiled on first Executor.run (Program → traced jax fn → XLA)."""
-
-    def __init__(self):
-        self._build_calls = []  # list of (fn, args, kwargs, out holder)
-        self._feeds = {}
-        self._fetch_cache = {}
-        self.random_seed = 0
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        import copy
-
-        return copy.copy(self)
-
-    def __repr__(self):
-        return f"<Program feeds={list(self._feeds)}>"
 
 
 def _ensure_state():
@@ -107,43 +84,288 @@ class name_scope:
         return False
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    var = _FeedVar(name, shape, dtype)
-    default_main_program()._feeds[name] = var
-    return var
-
+# -- static mode switch -----------------------------------------------------
 
 _static_flag = threading.local()
 
 
 def _enable_static():
     _static_flag.on = True
+    engine.set_static_record_hook(
+        StaticRecorder(_static_mode, default_main_program))
 
 
 def _disable_static():
     _static_flag.on = False
+    engine.set_static_record_hook(None)
 
 
 def _static_mode():
     return getattr(_static_flag, "on", False)
 
 
+def _program_symbolic_batch(prog):
+    """One shared symbolic batch dim per program (jax.export scopes
+    can't mix) — static.data(shape=[None, ...])."""
+    sym = getattr(prog, "_sym_batch", None)
+    if sym is None:
+        from jax import export as jexport
+
+        sym = jexport.symbolic_shape(f"_sb{id(prog) % 10_000}")[0]
+        prog._sym_batch = sym
+    return sym
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static.data): a Variable whose aval
+    may carry ONE symbolic batch dim (None/-1)."""
+    from ..core.dtype import convert_dtype
+
+    prog = default_main_program()
+    shp = list(shape)
+    if any(d in (None, -1) for d in shp):
+        sym = _program_symbolic_batch(prog)
+        shp = [sym if d in (None, -1) else int(d) for d in shp]
+    aval = jax.ShapeDtypeStruct(tuple(shp), convert_dtype(dtype))
+    var = Variable(aval, name=name, stop_gradient=True)
+    prog._feeds[name] = var
+    prog.global_block().vars[name] = var
+    return var
+
+
+# -- static autodiff --------------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Static autodiff (reference fluid/backward.py:1413): creates
+    @GRAD Variables for the targets wrt this specific loss; Executor
+    computes them with jax.grad over the replayed program. Targets may
+    be Parameters OR feed/intermediate Variables. Multiple calls (for
+    different losses) coexist — a grad Variable remembers which loss it
+    differentiates, so ad-hoc gradients() never retargets a configured
+    train step."""
+    prog = default_main_program()
+    if parameter_list is None:
+        targets = [p for p in prog.all_parameters()
+                   if getattr(p, "trainable", True)]
+    else:
+        targets = list(parameter_list)
+    prog._grad_of = getattr(prog, "_grad_of", {})
+    pairs = []
+    for t in targets:
+        g = Variable(jax.ShapeDtypeStruct(tuple(t._value.shape),
+                                          t._value.dtype),
+                     name=(t.name or prog.new_var_name("var")) + "@GRAD",
+                     stop_gradient=True)
+        prog._grad_of[id(g)] = (loss, t)
+        pairs.append((t, g))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    if _static_mode():
+        t = targets[0] if isinstance(targets, (list, tuple)) else targets
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return [g for _, g in append_backward(t, parameter_list=ins)]
+    from ..core.engine import grad
+
+    return grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def _record_minimize(optimizer, loss, parameter_list=None):
+    """optimizer.minimize(loss) in static mode: append_backward + mark
+    the program as a train program (update ops ≙ the functional
+    optimizer applied in the Executor's compiled step)."""
+    pgs = append_backward(loss, parameter_list=parameter_list)
+    prog = default_main_program()
+    prog._optimizer = optimizer
+    prog._loss_var = loss
+    prog._opt_state = None  # lazy-init at first run
+    return None, pgs
+
+
+# -- executor ---------------------------------------------------------------
+
 class Executor:
-    """Static executor. In this build a Program is a thin record; user
-    graphs written dygraph-style + to_static are the compiled path.
-    Executor supports the feed/fetch protocol for recorded programs
-    built from nn layers via static-bridge helpers."""
+    """Whole-program executor: Program → replay inside jax.jit → XLA
+    (reference executor.py:1093 Executor.run; the jit'd replay is the
+    StandaloneExecutor/InterpreterCore analog with XLA doing scheduling,
+    fusion, and memory planning)."""
 
     def __init__(self, place=None):
         self.place = place
+        self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True):
-        raise NotImplementedError(
-            "Program-based static execution: build models in dygraph and "
-            "use paddle_tpu.jit.to_static / TrainStepCompiler — the "
-            "Program→HLO bridge for raw fluid-style graphs is scheduled "
-            "(see SURVEY.md §7 step 4).")
+            return_numpy=True, scope=None):
+        prog = program if program is not None else default_main_program()
+        if isinstance(prog, CompiledProgram):
+            prog = prog.program
+        if isinstance(prog, _LoadedInferenceProgram):
+            return prog._run(feed or {}, fetch_list, return_numpy)
+        if not isinstance(prog, Program):
+            raise TypeError(f"cannot run {type(prog)}")
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        if not any(b.ops for b in prog.blocks):
+            return []  # startup program: params are eagerly initialized
+
+        train = prog._optimizer is not None
+        grad_of = getattr(prog, "_grad_of", {})
+        grad_fetches = [f for f in fetch_list
+                        if isinstance(f, Tensor) and id(f) in grad_of]
+        need_grads = train or bool(grad_fetches)
+
+        t_params = [p for p in prog.all_parameters()
+                    if getattr(p, "trainable", True)]
+        pkeys = [f"p{i}" for i in range(len(t_params))]
+        if train and prog._opt_state is None:
+            prog._opt_state = prog._optimizer.init_state(
+                {k: p._value for k, p in zip(pkeys, t_params)})
+
+        feed_names = tuple(sorted(feed))
+        shapes = tuple(tuple(np.shape(feed[n])) for n in feed_names)
+        key = (id(prog), feed_names, shapes, train, need_grads,
+               tuple(self._fetch_key(f) for f in fetch_list))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(prog, feed_names, fetch_list, t_params,
+                                   pkeys, train, need_grads, grad_of)
+            self._cache[key] = compiled
+
+        feed_vals = {n: jnp.asarray(np.asarray(feed[n]))
+                     for n in feed_names}
+        pvals = {k: p._value for k, p in zip(pkeys, t_params)}
+        if train:
+            lr = np.float32(prog._optimizer.get_lr())
+            fetched, new_p, new_s = compiled(feed_vals, pvals,
+                                             prog._opt_state, lr)
+            prog._opt_state = new_s
+            for k, p in zip(pkeys, t_params):
+                p._value = new_p[k]
+            prog._optimizer._step_count += 1
+        else:
+            fetched = compiled(feed_vals, pvals)
+        if return_numpy:
+            return [np.asarray(v) for v in fetched]
+        return [Tensor(v, _internal=True, stop_gradient=True)
+                for v in fetched]
+
+    @staticmethod
+    def _fetch_key(f):
+        return id(f) if isinstance(f, Tensor) else str(f)
+
+    def _build(self, prog, feed_names, fetch_list, t_params, pkeys,
+               train, need_grads, grad_of):
+        feed_vars = {n: prog._feeds[n] for n in feed_names
+                     if n in prog._feeds}
+        kidx = {id(p): k for k, p in zip(pkeys, t_params)}
+        var_feed_name = {id(v): n for n, v in feed_vars.items()}
+
+        def forward_env(feed_vals, pvals):
+            env = {}
+            for n, var in feed_vars.items():
+                env[id(var)] = feed_vals[n]
+            for k, p in zip(pkeys, t_params):
+                env[id(p)] = pvals[k]
+            replay_block(prog.global_block(), env)
+            return env
+
+        # grad fetches grouped by the loss they differentiate; each
+        # group gets ONE jax.grad pass wrt (params ∪ requested feeds)
+        grad_fetch_ids = [id(f) for f in fetch_list
+                          if isinstance(f, Tensor) and id(f) in grad_of]
+        by_loss = {}
+        for gid in grad_fetch_ids:
+            loss_v, target = grad_of[gid]
+            by_loss.setdefault(id(loss_v), (loss_v, []))[1].append(
+                (gid, target))
+        if train and prog._loss_var is not None:
+            by_loss.setdefault(id(prog._loss_var),
+                               (prog._loss_var, []))
+
+        def compute_grads(feed_vals, pvals):
+            """-> (env, {grad_var_id: value}, {pkey: grad}) where the
+            last is the train loss's param grads."""
+            genv = forward_env(feed_vals, pvals)  # plain env for fetches
+            gvals = {}
+            train_grads = None
+            for lid, (loss_v, items) in by_loss.items():
+                targets = [t for _, t in items]
+                extra_feeds = [t for t in targets
+                               if id(t) in var_feed_name]
+                want_params = (train and prog._loss_var is not None
+                               and lid == id(prog._loss_var))
+
+                def loss_of(pv, fv_sel):
+                    fv = dict(feed_vals)
+                    for t, v in zip(extra_feeds, fv_sel):
+                        fv[var_feed_name[id(t)]] = v
+                    env = forward_env(fv, pv)
+                    lv = env[id(loss_v)]
+                    return jnp.reshape(lv, ()).astype(jnp.float32)
+
+                fv_sel = tuple(feed_vals[var_feed_name[id(t)]]
+                               for t in extra_feeds)
+                p_grads, f_grads = jax.grad(loss_of, argnums=(0, 1))(
+                    pvals, fv_sel)
+                if want_params:
+                    train_grads = p_grads
+                fg = {id(t): g for t, g in zip(extra_feeds, f_grads)}
+                for gid, t in items:
+                    if id(t) in fg:
+                        gvals[gid] = fg[id(t)]
+                    elif id(t) in kidx:
+                        gvals[gid] = p_grads[kidx[id(t)]]
+                    else:
+                        raise KeyError(
+                            f"gradient target {getattr(t, 'name', t)!r} "
+                            "is neither a trainable parameter nor a fed "
+                            "Variable")
+            return genv, gvals, train_grads
+
+        def lookup_fetch(f, env, gvals):
+            if isinstance(f, Tensor):
+                if id(f) in gvals:
+                    return gvals[id(f)]
+                if id(f) in env:
+                    return env[id(f)]
+                if not isinstance(f, Variable):
+                    return f._value
+                raise KeyError(f"fetch {f!r} not produced by program")
+            for blk in prog.blocks:
+                if f in blk.vars:
+                    return env[id(blk.vars[f])]
+            raise KeyError(f"fetch name {f!r} not found")
+
+        if not need_grads:
+            def fn(feed_vals, pvals):
+                env = forward_env(feed_vals, pvals)
+                return [lookup_fetch(f, env, {}) for f in fetch_list]
+
+            return jax.jit(fn)
+
+        if train:
+            if prog._loss_var is None:
+                raise RuntimeError("train program has no loss — call "
+                                   "optimizer.minimize(loss) first")
+            opt = prog._optimizer
+
+            def step(feed_vals, pvals, opt_state, lr):
+                env, gvals, train_grads = compute_grads(feed_vals, pvals)
+                new_p, new_s = opt.apply_gradients(pvals, train_grads,
+                                                   opt_state, lr)
+                fetched = [lookup_fetch(f, env, gvals)
+                           for f in fetch_list]
+                return fetched, new_p, new_s
+
+            return jax.jit(step)
+
+        def evalgrad(feed_vals, pvals):
+            env, gvals, _ = compute_grads(feed_vals, pvals)
+            return [lookup_fetch(f, env, gvals) for f in fetch_list]
+
+        return jax.jit(evalgrad)
 
 
 class CompiledProgram:
@@ -175,23 +397,126 @@ class ExecutionStrategy:
         self.num_iteration_per_drop_scope = 100
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None):
-    raise NotImplementedError(
-        "append_backward on raw Programs: use dygraph autograd "
-        "(loss.backward()) or jit.TrainStepCompiler.")
+# -- inference save/load ----------------------------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Prune the program to the feed→fetch subgraph, export as
+    StableHLO + params (reference static/io.py save_inference_model;
+    artifact-compatible with paddle_tpu.jit.load /
+    inference.create_predictor)."""
+    from jax import export as jexport
+    import jax.tree_util as tree_util
+
+    from ..jit import write_saved_artifacts
+
+    feed_vars = (feed_vars if isinstance(feed_vars, (list, tuple))
+                 else [feed_vars])
+    fetch_vars = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                  else [fetch_vars])
+    prog = program
+    if prog is None:
+        # the program that actually produced the fetch vars wins over
+        # the ambient default (save may be called outside program_guard)
+        blk = getattr(fetch_vars[0], "block", None)
+        prog = blk.program if blk is not None else default_main_program()
+
+    # backward-slice from the fetches: keep only ops the fetches depend
+    # on, so a train program's loss/label ops don't leak into the
+    # inference graph (reference: Program._prune_with_input)
+    needed = {id(v) for v in fetch_vars}
+    ops = []
+    for op in reversed(prog.global_block().ops):
+        if any(id(v) in needed for v in op.out_vars):
+            ops.append(op)
+            for leaf in op.in_leaves:
+                if isinstance(leaf, Tensor):
+                    needed.add(id(leaf))
+    ops.reverse()
+    fed = {id(v) for v in feed_vars}
+    produced = {id(o) for op in ops for o in op.out_vars}
+    for op in ops:
+        for leaf in op.in_leaves:
+            if (isinstance(leaf, Variable) and id(leaf) not in fed
+                    and id(leaf) not in produced):
+                raise ValueError(
+                    f"save_inference_model: fetch depends on Variable "
+                    f"{leaf.name!r} which is not among feed_vars")
+
+    t_params = [p for p in prog.all_parameters()]
+    pkeys = [f"p{i}" for i in range(len(t_params))]
+
+    def fn(pvals, bvals, *feed_vals):
+        env = {}
+        for var, v in zip(feed_vars, feed_vals):
+            env[id(var)] = v
+        for k, p in zip(pkeys, t_params):
+            env[id(p)] = pvals[k]
+        from .graph import resolve_leaf
+        for op in ops:
+            vals = [resolve_leaf(x, env) for x in op.in_leaves]
+            uargs = tree_util.tree_unflatten(op.in_treedef, vals)
+            out = op.fn(*uargs, **op.kwargs)
+            out_flat, _ = tree_util.tree_flatten(out)
+            for var, v in zip(op.out_vars, out_flat):
+                env[id(var)] = v
+        return [env[id(f)] for f in fetch_vars]
+
+    feed_avals = [jax.ShapeDtypeStruct(tuple(v._value.shape),
+                                       v._value.dtype)
+                  for v in feed_vars]
+    pavals = {k: jax.ShapeDtypeStruct(tuple(p._value.shape),
+                                      p._value.dtype)
+              for k, p in zip(pkeys, t_params)}
+    exported = jexport.export(jax.jit(fn))(pavals, {}, *feed_avals)
+
+    write_saved_artifacts(
+        path_prefix, exported,
+        {k: p for k, p in zip(pkeys, t_params)}, {},
+        {"out_treedef": tree_util.tree_structure([0] * len(fetch_vars)),
+         "input_spec": [(tuple(v._value.shape), str(v._value.dtype))
+                        for v in feed_vars],
+         "feed_names": [v.name for v in feed_vars],
+         "class": "static_program"})
 
 
-def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    from ..core.engine import grad
+class _LoadedInferenceProgram:
+    """(program, feed_names, fetch_targets) triple returned by
+    load_inference_model; runnable via Executor.run."""
 
-    return grad(targets, inputs, grad_outputs=target_gradients)
+    def __init__(self, layer, feed_names):
+        self._layer = layer
+        self.feed_names = feed_names
+
+    def _run(self, feed, fetch_list, return_numpy=True):
+        vals = [feed[n] for n in self.feed_names]
+        out = self._layer(*vals)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        if fetch_list:
+            idx = [f if isinstance(f, int) else i
+                   for i, f in enumerate(fetch_list)]
+            out = [out[i] for i in idx]
+        if return_numpy:
+            return [np.asarray(o._value if isinstance(o, Tensor) else o)
+                    for o in out]
+        return list(out)
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.save")
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """reference static/io.py load_inference_model → (program,
+    feed_target_names, fetch_targets)."""
+    import pickle
 
+    from ..jit import load as jit_load
 
-def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.load")
+    layer = jit_load(path_prefix)
+    try:
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+        feed_names = meta.get("feed_names") or [
+            f"x{i}" for i in range(len(meta.get("input_spec", [])))]
+    except FileNotFoundError:
+        feed_names = []
+    prog = _LoadedInferenceProgram(layer, feed_names)
+    n_out = layer._out_treedef.num_leaves
+    return [prog, feed_names, list(range(n_out))]

@@ -1,0 +1,404 @@
+"""Static-graph IR: Program/Block/Variable/OpRecord + replay.
+
+Parity target: the reference's Program/Block/Variable proto IR
+(`python/paddle/fluid/framework.py`, `paddle/fluid/framework/
+program_desc.cc`), LayerHelper.append_op op recording, and the
+control-flow ops (`python/paddle/fluid/layers/control_flow.py` While /
+cond → `paddle/fluid/operators/controlflow/`).
+
+TPU-native design: an op record stores the op's pure jax kernel plus
+references to its input Variables; Executor lowers a whole Program by
+REPLAYING the records inside one `jax.jit` trace (Program → XLA HLO —
+SURVEY §7 step 4: "the executor is a Program→HLO compiler").
+Shape/dtype inference at record time is `jax.eval_shape` (InferMeta ≙
+jax avals, SURVEY §2.1). Control flow records nested sub-blocks and
+replays them under `lax.cond` / `lax.while_loop`, which is exactly the
+XLA conditional/while the reference's While/Cond ops would need a
+custom lowering for.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import tree_util
+
+from ..core import engine
+from ..core.tensor import Tensor
+
+__all__ = ["Variable", "OpRecord", "Block", "Program", "StaticRecorder",
+           "cond", "while_loop"]
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program — `_value` is a ShapeDtypeStruct
+    (aval), so shape/dtype introspection works while op recording is
+    on; there is no data until Executor.run (reference:
+    framework.py Variable)."""
+
+    def __init__(self, aval, name=None, stop_gradient=False):
+        super().__init__(aval, _internal=True, stop_gradient=stop_gradient,
+                         name=name)
+        self.block = None
+        self.persistable = False
+
+    @property
+    def aval(self):
+        return self._value
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value at graph-build time — "
+            "fetch it through Executor.run(fetch_list=[...])")
+
+    item = numpy
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self.shape)}, "
+                f"dtype={self._value.dtype})")
+
+
+def _is_var(x):
+    return isinstance(x, Variable)
+
+
+def _leaf(x):
+    return x is None or isinstance(x, Tensor)
+
+
+class OpRecord:
+    """One recorded op (OpDesc analog): kernel + input refs + attrs."""
+
+    __slots__ = ("type", "fn", "in_treedef", "in_leaves", "kwargs",
+                 "out_treedef", "out_vars")
+
+    def __init__(self, type_, fn, in_treedef, in_leaves, kwargs,
+                 out_treedef, out_vars):
+        self.type = type_
+        self.fn = fn
+        self.in_treedef = in_treedef
+        self.in_leaves = in_leaves  # Variables / concrete Tensors / None
+        self.kwargs = kwargs
+        self.out_treedef = out_treedef
+        self.out_vars = out_vars
+
+    def __repr__(self):
+        return f"<op {self.type} -> {[v.name for v in self.out_vars]}>"
+
+
+class Block:
+    """Op list + produced-variable registry (BlockDesc analog)."""
+
+    def __init__(self, program, idx=0, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.ops = []
+        self.vars = {}
+
+    def append_op_record(self, rec):
+        self.ops.append(rec)
+        for v in rec.out_vars:
+            v.block = self
+            self.vars[v.name] = v
+
+    def var(self, name):
+        return self.vars[name]
+
+    def produced_ids(self):
+        out = set()
+        for op in self.ops:
+            out.update(id(v) for v in op.out_vars)
+        return out
+
+    def external_inputs(self):
+        """Leaves consumed but not produced in this block: outer
+        Variables and concrete Tensors (params). Order is deterministic
+        (first use)."""
+        produced = self.produced_ids()
+        seen, ext = set(), []
+        for op in self.ops:
+            for leaf in op.in_leaves:
+                if leaf is None or not isinstance(leaf, Tensor):
+                    continue
+                if id(leaf) in produced or id(leaf) in seen:
+                    continue
+                seen.add(id(leaf))
+                ext.append(leaf)
+        return ext
+
+
+class Program:
+    """Recorded graph (ProgramDesc analog). `blocks[0]` is the global
+    block; control flow adds sub-blocks."""
+
+    _name_counter = [0]
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self._block_stack = [0]
+        self._feeds = {}          # name -> Variable (static.data)
+        self.random_seed = 0
+        # set by append_backward / optimizer.minimize
+        self._loss_var = None
+        self._param_grads = None  # list[(Parameter, Variable)]
+        self._optimizer = None
+        self._opt_state = None
+
+    # -- block management -------------------------------------------------
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self._block_stack[-1]]
+
+    def _push_block(self):
+        blk = Block(self, len(self.blocks),
+                    parent_idx=self._block_stack[-1])
+        self.blocks.append(blk)
+        self._block_stack.append(blk.idx)
+        return blk
+
+    def _pop_block(self):
+        self._block_stack.pop()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    def new_var_name(self, prefix="tmp"):
+        self._name_counter[0] += 1
+        return f"{prefix}_{self._name_counter[0]}"
+
+    def clone(self, for_test=False):
+        import copy
+
+        c = copy.copy(self)
+        if for_test:
+            c._loss_var = self._loss_var
+        return c
+
+    def all_parameters(self):
+        """Concrete Parameter leaves referenced by recorded ops."""
+        seen, params = set(), []
+        for blk in self.blocks:
+            for op in blk.ops:
+                for leaf in op.in_leaves:
+                    if (isinstance(leaf, Tensor) and not _is_var(leaf)
+                            and getattr(leaf, "is_parameter", False)
+                            and id(leaf) not in seen):
+                        seen.add(id(leaf))
+                        params.append(leaf)
+        return params
+
+    def __repr__(self):
+        n = sum(len(b.ops) for b in self.blocks)
+        return (f"<Program blocks={len(self.blocks)} ops={n} "
+                f"feeds={list(self._feeds)}>")
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+class StaticRecorder:
+    """apply_op hook: when static mode is on and an op touches a
+    Variable, append an OpRecord and return symbolic outputs."""
+
+    def __init__(self, mode_check, program_getter):
+        self._on = mode_check
+        self._prog = program_getter
+
+    def __call__(self, name, fn, args, kwargs):
+        if not self._on():
+            return NotImplemented
+        flat, treedef = tree_util.tree_flatten(args, is_leaf=_leaf)
+        if not any(_is_var(x) for x in flat):
+            return NotImplemented
+        prog = self._prog()
+        return record_op(prog, name, fn, flat, treedef, kwargs)
+
+
+def record_op(prog, name, fn, flat_leaves, in_treedef, kwargs):
+    avals = []
+    for x in flat_leaves:
+        if _is_var(x):
+            avals.append(x._value)
+        elif isinstance(x, Tensor):
+            avals.append(x._value)
+        else:
+            avals.append(x)
+    uargs = tree_util.tree_unflatten(in_treedef, avals)
+    out = jax.eval_shape(functools.partial(fn, **kwargs), *uargs)
+    out_flat, out_treedef = tree_util.tree_flatten(out)
+    out_vars = [Variable(a, name=prog.new_var_name(name))
+                for a in out_flat]
+    rec = OpRecord(name, fn, in_treedef, list(flat_leaves), dict(kwargs),
+                   out_treedef, out_vars)
+    prog.current_block().append_op_record(rec)
+    wrapped = tree_util.tree_unflatten(out_treedef, out_vars)
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Replay (Program -> jax computation)
+# ---------------------------------------------------------------------------
+
+def resolve_leaf(leaf, env):
+    if leaf is None:
+        return None
+    if isinstance(leaf, Tensor):
+        v = env.get(id(leaf))
+        if v is not None:
+            return v
+        if _is_var(leaf):
+            raise KeyError(
+                f"Variable {leaf.name!r} has no value — not a feed and "
+                "not produced by any recorded op")
+        return leaf._value  # concrete (non-trainable or frozen) tensor
+    return leaf
+
+
+def replay_block(block, env):
+    """Execute a block's records in order; env: id(var) -> value."""
+    for op in block.ops:
+        vals = [resolve_leaf(x, env) for x in op.in_leaves]
+        uargs = tree_util.tree_unflatten(op.in_treedef, vals)
+        out = op.fn(*uargs, **op.kwargs)
+        out_flat, _ = tree_util.tree_flatten(out)
+        for var, v in zip(op.out_vars, out_flat):
+            env[id(var)] = v
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Control flow (While/Cond op analogs -> lax.while_loop / lax.cond)
+# ---------------------------------------------------------------------------
+
+def _record_subblock(prog, fn, args=()):
+    blk = prog._push_block()
+    try:
+        out = fn(*args)
+    finally:
+        prog._pop_block()
+    out_flat, out_tree = tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    return blk, out_flat, out_tree
+
+
+def _branch_replayer(blk, out_flat, ext_leaves):
+    def run(ext_vals, seed_env=None):
+        env = dict(seed_env or {})
+        for leaf, v in zip(ext_leaves, ext_vals):
+            env[id(leaf)] = v
+        replay_block(blk, env)
+        return tuple(
+            env[id(o)] if isinstance(o, Tensor) and id(o) in env
+            else (o._value if isinstance(o, Tensor) else o)
+            for o in out_flat)
+
+    return run
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond (reference control_flow.py cond) —
+    records both branches as sub-blocks, replayed via lax.cond."""
+    from . import _static_mode, default_main_program
+
+    if not (_static_mode() and isinstance(pred, Variable)):
+        # dygraph / concrete: plain python dispatch
+        p = pred.item() if isinstance(pred, Tensor) else bool(pred)
+        return true_fn() if p else false_fn()
+
+    prog = default_main_program()
+    tb, t_out, t_tree = _record_subblock(prog, true_fn)
+    fb, f_out, f_tree = _record_subblock(prog, false_fn)
+    if t_tree != f_tree:
+        raise ValueError("cond: true_fn and false_fn must return the "
+                         f"same structure, got {t_tree} vs {f_tree}")
+    for a, b in zip(t_out, f_out):
+        sa = tuple(a.shape) if isinstance(a, Tensor) else np.shape(a)
+        sb = tuple(b.shape) if isinstance(b, Tensor) else np.shape(b)
+        if sa != sb:
+            raise ValueError(f"cond: branch output shapes differ "
+                             f"{sa} vs {sb}")
+
+    # externals of both branches, deduped, order-stable
+    ext, seen = [], set()
+    for leaf in tb.external_inputs() + fb.external_inputs():
+        if id(leaf) not in seen:
+            seen.add(id(leaf))
+            ext.append(leaf)
+    t_run = _branch_replayer(tb, t_out, ext)
+    f_run = _branch_replayer(fb, f_out, ext)
+
+    def _k_cond(pred_v, ext_vals):
+        pv = jnp.asarray(pred_v).reshape(()).astype(bool)
+        return jax.lax.cond(pv, lambda e: t_run(e), lambda e: f_run(e),
+                            tuple(ext_vals))
+
+    out = engine.apply_op("conditional_block", _k_cond, pred, list(ext))
+    return tree_util.tree_unflatten(
+        t_tree, out if isinstance(out, (tuple, list)) else [out])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop (reference control_flow.py While) —
+    body/cond recorded once as sub-blocks, replayed via
+    lax.while_loop."""
+    from . import _static_mode, default_main_program
+    from .. import to_tensor
+
+    if not (_static_mode() and any(_is_var(v) for v in loop_vars)):
+        vars_ = list(loop_vars)
+        while True:
+            c = cond_fn(*vars_)
+            if not bool(c.item() if isinstance(c, Tensor) else c):
+                break
+            vars_ = list(body_fn(*vars_))
+        return vars_
+
+    prog = default_main_program()
+    lv = list(loop_vars)
+    cb, c_out, _ = _record_subblock(prog, cond_fn, lv)
+    bb, b_out, b_tree = _record_subblock(prog, body_fn, lv)
+    if len(b_out) != len(lv):
+        raise ValueError("while_loop: body_fn must return as many values "
+                         "as loop_vars")
+
+    loop_ids = {id(v) for v in lv}
+    ext, seen = [], set(loop_ids)
+    for leaf in cb.external_inputs() + bb.external_inputs():
+        if id(leaf) not in seen:
+            seen.add(id(leaf))
+            ext.append(leaf)
+
+    def _k_while(init_vals, ext_vals):
+        ext_env = {id(leaf): v for leaf, v in zip(ext, ext_vals)}
+
+        def cond_c(carry):
+            env = dict(ext_env)
+            for v, val in zip(lv, carry):
+                env[id(v)] = val
+            replay_block(cb, env)
+            co = c_out[0]
+            cv = env[id(co)] if isinstance(co, Tensor) else co
+            return jnp.asarray(cv).reshape(()).astype(bool)
+
+        def body_c(carry):
+            env = dict(ext_env)
+            for v, val in zip(lv, carry):
+                env[id(v)] = val
+            replay_block(bb, env)
+            return tuple(
+                env[id(o)] if isinstance(o, Tensor) and id(o) in env
+                else (o._value if isinstance(o, Tensor) else o)
+                for o in b_out)
+
+        return jax.lax.while_loop(cond_c, body_c, tuple(init_vals))
+
+    out = engine.apply_op("while", _k_while, list(lv), list(ext))
+    return list(out) if isinstance(out, (tuple, list)) else [out]

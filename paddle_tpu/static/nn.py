@@ -1,0 +1,101 @@
+"""paddle.static.nn — static-graph layer helpers + control flow.
+
+Parity target: python/paddle/static/nn/__init__.py (fc, conv2d,
+batch_norm, embedding wrappers over LayerHelper.append_op) and
+fluid/layers/control_flow.py (cond, while_loop, switch_case).
+
+TPU-native: these delegate to the same functional kernels the dygraph
+layers use; in static mode the apply_op recorder captures them into the
+Program, so one code path serves both regimes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import cond, while_loop
+
+__all__ = ["fc", "cond", "while_loop", "switch_case", "embedding",
+           "batch_norm", "conv2d"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py fc: y = act(x W + b) with lazily
+    created parameters (cached on the variable's program)."""
+    from ..nn import Linear
+    from .. import nn as nn_mod
+
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_features, size)
+    if len(x.shape) > num_flatten_dims + 1:
+        from ..ops.manipulation import reshape
+
+        x = reshape(x, [*x.shape[:num_flatten_dims], in_features])
+    y = layer(x)
+    if activation:
+        y = getattr(nn_mod.functional, activation)(y)
+    # keep the layer alive: its params are leaves of the recorded ops
+    y._fc_layer = layer
+    return y
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx)
+    y = layer(input)
+    y._emb_layer = layer
+    return y
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           groups=1, param_attr=None, bias_attr=None, act=None):
+    from ..nn import Conv2D
+    from .. import nn as nn_mod
+
+    in_ch = input.shape[1]
+    layer = Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                   padding=padding, groups=groups)
+    y = layer(input)
+    if act:
+        y = getattr(nn_mod.functional, act)(y)
+    y._conv_layer = layer
+    return y
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, is_test=False):
+    from ..nn import BatchNorm2D
+    from .. import nn as nn_mod
+
+    layer = BatchNorm2D(input.shape[1], momentum=momentum,
+                        epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    y = layer(input)
+    if act:
+        y = getattr(nn_mod.functional, act)(y)
+    y._bn_layer = layer
+    return y
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference control_flow.py switch_case → chained cond."""
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and \
+        branch_fns and isinstance(branch_fns[0], (list, tuple)) else None
+    if fns is None:
+        fns = (dict(enumerate(branch_fns))
+               if isinstance(branch_fns, (list, tuple)) else
+               dict(branch_fns))
+    keys = sorted(fns)
+    if default is None:
+        default = fns[keys[-1]]
+
+    def build(i):
+        if i >= len(keys):
+            return default()
+        k = keys[i]
+        return cond(branch_index == k, fns[k], lambda: build(i + 1))
+
+    return build(0)
