@@ -239,18 +239,12 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
-class WorkerInfo:
-    def __init__(self, id, num_workers, dataset):
-        self.id = id
-        self.num_workers = num_workers
-        self.dataset = dataset
-
-
-_worker_info = None
-
-
 def get_worker_info():
-    return _worker_info
+    """In a worker process, describes this worker (reference
+    dataloader/worker.py WorkerInfo); None in the main process."""
+    from .worker import get_worker_info as _gwi
+
+    return _gwi()
 
 
 def default_collate_fn(batch):
@@ -299,10 +293,15 @@ def _to_device(obj):
 
 
 class DataLoader:
-    """reference: fluid/reader.py:146. num_workers>0 uses a thread pool
-    prefetcher (XLA host work releases the GIL during transfers; Python
-    transforms dominate rarely on TPU input pipelines). A true
-    multiprocess path via the C prefetch ring is in utils/cpp."""
+    """reference: fluid/reader.py:146 + dataloader_iter.py:326.
+
+    num_workers>0, use_shared_memory=True (default): REAL multiprocess
+    workers — forked processes compute/collate batches and hand them to
+    the trainer through C shared-memory SPSC rings
+    (utils/cpp/shm_ring.cc, the mmap_allocator.cc analog); supports
+    worker_init_fn, timeout, and persistent_workers. With
+    use_shared_memory=False, a thread prefetcher is used instead
+    (enough when transforms are numpy-light)."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -315,6 +314,11 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._mp_loader = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -366,12 +370,57 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
 
+    def _multiprocess_iter(self):
+        from .worker import MultiprocessLoader
+
+        def make_loader():
+            slot_mb = int(__import__("os").environ.get(
+                "FLAGS_dataloader_shm_slot_mb", "64"))
+            return MultiprocessLoader(
+                self.dataset, self.collate_fn or _np_collate,
+                self.num_workers, max(2, self.prefetch_factor),
+                slot_mb, self.worker_init_fn, self.timeout,
+                self.persistent_workers,
+                iterable_mode=self._iterable_mode,
+                batch_size=self.batch_size or 1,
+                drop_last=self.drop_last)
+
+        if self.persistent_workers:
+            # one long-lived worker pool; run_epoch serializes epochs
+            # (a second concurrent iterator raises)
+            if self._mp_loader is None:
+                self._mp_loader = make_loader()
+            loader, owned = self._mp_loader, False
+        else:
+            # each iterator owns an independent pool — concurrent
+            # iterators (zip(dl, dl)) cannot corrupt each other
+            loader, owned = make_loader(), True
+
+        if self.batch_sampler is not None:
+            batches = iter(self.batch_sampler)
+        elif not self._iterable_mode:
+            # batch_size=None: one sample per index (matches the
+            # single-process path)
+            batches = ([i] for i in range(len(self.dataset)))
+        else:
+            batches = []
+        raw = self.collate_fn is not None
+        try:
+            for batch in loader.run_epoch(batches):
+                yield batch if raw else _to_device(batch)
+        finally:
+            if owned:
+                loader.shutdown()
+
     def __iter__(self):
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
-        # threaded prefetch: workers pull index batches, push collated
-        # numpy; main thread does device_put
+        if self.use_shared_memory:
+            yield from self._multiprocess_iter()
+            return
+        # threaded prefetch fallback: producer thread pulls batches,
+        # main thread does device_put
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 

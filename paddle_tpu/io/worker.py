@@ -1,0 +1,354 @@
+"""Multiprocess DataLoader workers with shared-memory transport.
+
+Parity target: python/paddle/fluid/dataloader/dataloader_iter.py:326
+(_DataLoaderIterMultiProcess), worker.py (worker loop + WorkerInfo),
+and the mmap shared-memory tensor path
+(paddle/fluid/memory/allocation/mmap_allocator.cc).
+
+TPU-native design: each worker OWNS one C shared-memory SPSC ring
+(utils/cpp/shm_ring.cc — lock-free head/tail atomics); batches are
+pickled (protocol 5) straight into the ring slot, so worker->trainer
+transport never touches a pipe. Batch i is assigned to worker i % W
+and the trainer pops rings in that order — global batch order is
+deterministic regardless of worker speed (the reference's reorder
+buffer, by construction). The trainer thread then hands bytes to PJRT
+host->device transfer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import queue
+import threading
+
+import numpy as np
+
+_EOF = b"\x00PDEOF"
+_ERR = b"\x00PDERR"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _ring_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            from ..utils.cpp_extension import load
+
+            src = os.path.join(os.path.dirname(__file__), "..", "utils",
+                               "cpp", "shm_ring.cc")
+            lib = load("shm_ring", [os.path.abspath(src)],
+                       extra_ldflags=["-lrt"])
+            lib.ring_open.restype = ctypes.c_void_p
+            lib.ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_uint64, ctypes.c_int]
+            lib.ring_push.restype = ctypes.c_int
+            lib.ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int64]
+            lib.ring_pop.restype = ctypes.c_int64
+            lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int64]
+            lib.ring_close.argtypes = [ctypes.c_void_p]
+            lib.ring_unlink.argtypes = [ctypes.c_char_p]
+            _lib = lib
+        return _lib
+
+
+class ShmRing:
+    """One SPSC ring in POSIX shared memory (ctypes over shm_ring.cc)."""
+
+    def __init__(self, name, slots, slot_bytes, create):
+        self._lib = _ring_lib()
+        self.name = name.encode()
+        self.slot_bytes = slot_bytes
+        self._h = self._lib.ring_open(self.name, slots, slot_bytes,
+                                      1 if create else 0)
+        if not self._h:
+            raise OSError(f"shm ring {name} open failed")
+        self._creator = create
+        self._buf = None  # lazy: workers only push; don't hold 64MB
+
+    def push(self, data: bytes, timeout_ms=-1):
+        rc = self._lib.ring_push(self._h, data, len(data), timeout_ms)
+        if rc == -2:
+            raise ValueError(
+                f"batch of {len(data)} bytes exceeds the shared-memory "
+                f"slot ({self.slot_bytes}B) — raise "
+                "FLAGS_dataloader_shm_slot_mb or shrink the batch")
+        return rc == 0
+
+    def pop(self, timeout_ms=-1):
+        if self._buf is None:
+            self._buf = ctypes.create_string_buffer(self.slot_bytes)
+        n = self._lib.ring_pop(self._h, self._buf, self.slot_bytes,
+                               timeout_ms)
+        if n == -1:
+            return None
+        if n < 0:
+            raise OSError(f"ring_pop error {n}")
+        return self._buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.ring_close(self._h)
+            self._h = None
+        if self._creator:
+            self._lib.ring_unlink(self.name)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """reference: paddle.io.get_worker_info (dataloader/worker.py)."""
+    return _worker_info
+
+
+def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
+                 slots, slot_bytes, index_queue, worker_init_fn,
+                 iterable_mode, batch_size, drop_last, base_seed):
+    """Runs in the child process: pull work, compute, push to the ring."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
+                              seed=base_seed + worker_id)
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    ring = ShmRing(ring_name, slots, slot_bytes, create=False)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable_mode:
+            # each worker consumes a strided shard of the iterable
+            # (reference _IterableDatasetStopIteration contract); the
+            # index queue carries per-epoch start markers so persistent
+            # workers serve any number of epochs
+            import itertools
+
+            while True:
+                item = index_queue.get()
+                if item == "QUIT":
+                    break
+                try:
+                    it = itertools.islice(iter(dataset), worker_id, None,
+                                          num_workers)
+                    while True:
+                        batch = list(itertools.islice(it, batch_size))
+                        if not batch or (len(batch) < batch_size
+                                         and drop_last):
+                            break
+                        ring.push(pickle.dumps(collate_fn(batch),
+                                               protocol=5))
+                except Exception as e:
+                    import traceback
+
+                    ring.push(_ERR + pickle.dumps(
+                        (type(e).__name__, traceback.format_exc())))
+                ring.push(_EOF)
+            return
+        while True:
+            item = index_queue.get()
+            if item is None:
+                ring.push(_EOF)
+                # persistent workers loop for the next epoch's indices
+                continue
+            if item == "QUIT":
+                break
+            try:
+                samples = [dataset[i] for i in item]
+                payload = pickle.dumps(collate_fn(samples), protocol=5)
+                ring.push(payload)
+            except Exception as e:  # surface the error to the trainer
+                import traceback
+
+                ring.push(_ERR + pickle.dumps(
+                    (type(e).__name__, traceback.format_exc())))
+    finally:
+        ring.close()
+
+
+class MultiprocessLoader:
+    """Trainer-side controller: W workers, W rings, ordered pops."""
+
+    def __init__(self, dataset, collate_fn, num_workers, prefetch_factor,
+                 slot_mb, worker_init_fn, timeout, persistent,
+                 iterable_mode=False, batch_size=1, drop_last=False):
+        import multiprocessing as mp
+
+        self._mp = mp.get_context("fork")
+        self.num_workers = num_workers
+        self.timeout_ms = int(timeout * 1000) if timeout else -1
+        self.persistent = persistent
+        self.iterable_mode = iterable_mode
+        slot_bytes = slot_mb * 1024 * 1024
+        slots = max(2, prefetch_factor)
+        self._slots = slots
+        self._busy = False
+        base = f"/pdtpu_{os.getpid()}_{id(self)}"
+        self.rings = []
+        self.queues = []
+        self.procs = []
+        base_seed = np.random.randint(0, 2 ** 31 - 1)
+        for w in range(num_workers):
+            ring_name = f"{base}_{w}"
+            ring = ShmRing(ring_name, slots, slot_bytes, create=True)
+            q = self._mp.Queue()
+            p = self._mp.Process(
+                target=_worker_loop,
+                args=(w, num_workers, dataset, collate_fn, ring_name,
+                      slots, slot_bytes, q, worker_init_fn,
+                      iterable_mode, batch_size, drop_last, base_seed),
+                daemon=True)
+            p.start()
+            self.rings.append(ring)
+            self.queues.append(q)
+            self.procs.append(p)
+
+    def run_epoch(self, index_batches):
+        """Feed indices round-robin with a bounded in-flight window;
+        yield deserialized batches in order. Batch k is assigned to
+        worker k % W and popped from ring k % W, so pops see each
+        ring's batches exactly in global order and every ring ends the
+        epoch with exactly one EOF marker. An early-exited epoch
+        (break / generator close) is drained in the finally so
+        persistent workers start the next epoch with clean rings."""
+        if self._busy:
+            raise RuntimeError(
+                "this DataLoader's persistent workers are already "
+                "serving an iterator — finish or close it before "
+                "starting another")
+        self._busy = True
+        try:
+            if self.iterable_mode:
+                yield from self._run_iterable()
+                return
+            it = iter(index_batches)
+            fed = popped = 0
+            window = self.num_workers * self._slots
+            done_feeding = False
+
+            def feed():
+                nonlocal fed, done_feeding
+                while not done_feeding and fed - popped < window:
+                    try:
+                        idxs = next(it)
+                    except StopIteration:
+                        done_feeding = True
+                        for q in self.queues:
+                            q.put(None)  # epoch end marker
+                        return
+                    self.queues[fed % self.num_workers].put(list(idxs))
+                    fed += 1
+
+            feed()
+            try:
+                while popped < fed or not done_feeding:
+                    payload = self._pop_checked(
+                        self.rings[popped % self.num_workers])
+                    popped += 1
+                    feed()
+                    yield pickle.loads(payload)
+            finally:
+                # early exit: flush remaining fed batches + all EOFs
+                # (skip when _pop_checked already shut us down)
+                if self.rings:
+                    if not done_feeding:
+                        done_feeding = True
+                        for q in self.queues:
+                            q.put(None)
+                    while popped < fed:
+                        self._pop_checked(
+                            self.rings[popped % self.num_workers])
+                        popped += 1
+                    for r in self.rings:
+                        self._pop_checked(r)  # EOF markers
+        finally:
+            self._busy = False
+
+    def _run_iterable(self):
+        for q in self.queues:
+            q.put("EPOCH")  # wake (persistent) workers for this epoch
+        live = set(range(self.num_workers))
+        w = 0
+        try:
+            while live:
+                if w not in live:
+                    w = (w + 1) % self.num_workers
+                    continue
+                payload = self._pop_checked(self.rings[w])
+                if payload == _EOF:
+                    live.discard(w)
+                else:
+                    yield pickle.loads(payload)
+                w = (w + 1) % self.num_workers
+        finally:
+            # early exit: drain until every worker's EOF arrives
+            # (skip when _pop_checked already shut us down)
+            while live and self.rings:
+                for w in list(live):
+                    payload = self._pop_checked(self.rings[w])
+                    if payload == _EOF:
+                        live.discard(w)
+
+    def _pop_checked(self, ring):
+        """Pop with liveness polling: a worker killed by the OS (or
+        crashed outside the guarded region) must raise, not hang."""
+        tick = 2000
+        waited = 0
+        while True:
+            budget = (self.timeout_ms if self.timeout_ms > 0
+                      else tick)
+            payload = ring.pop(min(budget, tick))
+            if payload is not None:
+                break
+            waited += tick
+            if self.timeout_ms > 0 and waited >= self.timeout_ms:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.timeout_ms} ms "
+                    "waiting for a worker batch")
+            if any(not p.is_alive() for p in self.procs):
+                self.shutdown()
+                raise RuntimeError(
+                    "a DataLoader worker process died unexpectedly "
+                    "(killed or crashed) — see worker logs")
+        if payload.startswith(_ERR):
+            name, tb = pickle.loads(payload[len(_ERR):])
+            self.shutdown()
+            raise RuntimeError(
+                f"DataLoader worker raised {name}:\n{tb}")
+        return payload
+
+    def shutdown(self):
+        for q in self.queues:
+            try:
+                q.put("QUIT")
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        for r in self.rings:
+            r.close()
+        self.procs, self.queues, self.rings = [], [], []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

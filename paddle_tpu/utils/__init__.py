@@ -1,0 +1,38 @@
+"""paddle.utils (reference: python/paddle/utils/ — cpp_extension build
+toolchain, download helpers, deprecations)."""
+from __future__ import annotations
+
+from . import cpp_extension
+
+__all__ = ["cpp_extension", "try_import", "run_check", "deprecated"]
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed")
+
+
+def run_check():
+    """paddle.utils.run_check analog: verify the device works."""
+    import jax
+    import numpy as np
+
+    from .. import to_tensor
+
+    t = to_tensor(np.ones((2, 2), np.float32))
+    out = (t @ t).numpy()
+    assert out[0, 0] == 2.0
+    dev = jax.devices()[0]
+    print(f"PaddleTPU works! device={dev.platform}:{dev.id}")
+
+
+def deprecated(update_to="", since="", reason=""):
+    def wrap(fn):
+        return fn
+
+    return wrap

@@ -1,0 +1,149 @@
+// Shared-memory SPSC ring buffer for DataLoader worker->main transport.
+//
+// Parity target: the reference's mmap shared-memory DataLoader IPC
+// (paddle/fluid/memory/allocation/mmap_allocator.cc +
+// fluid/dataloader/worker.py): worker processes place collated numpy
+// batches in shared memory; the trainer process consumes them without
+// a pipe copy. TPU-native twist: the consumer hands the bytes straight
+// to PJRT host->device transfer.
+//
+// Design: one ring per worker (single producer, single consumer), so
+// synchronization is two C11 atomics (head/tail) with acquire/release
+// ordering — no locks, no semaphores. Blocking ops spin with usleep
+// and honor a timeout.
+//
+// Build: compiled on demand by paddle_tpu.utils.cpp_extension.load()
+// (the PD_REGISTER_KERNEL-era custom-op toolchain analog).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  uint64_t slots;
+  uint64_t slot_bytes;
+  std::atomic<uint64_t> head;  // next slot to write (producer)
+  std::atomic<uint64_t> tail;  // next slot to read (consumer)
+};
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* data;  // slots * (8-byte length prefix + slot_bytes)
+  size_t map_bytes;
+  int fd;
+};
+
+inline uint8_t* slot_ptr(Ring* r, uint64_t idx) {
+  uint64_t stride = 8 + r->hdr->slot_bytes;
+  return r->data + (idx % r->hdr->slots) * stride;
+}
+
+size_t total_bytes(uint64_t slots, uint64_t slot_bytes) {
+  return sizeof(RingHeader) + slots * (8 + slot_bytes);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (0 on failure). create=1 initializes.
+void* ring_open(const char* name, uint64_t slots, uint64_t slot_bytes,
+                int create) {
+  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t bytes = total_bytes(slots, slot_bytes);
+  if (create && ftruncate(fd, (off_t)bytes) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = reinterpret_cast<RingHeader*>(mem);
+  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_bytes = bytes;
+  r->fd = fd;
+  if (create) {
+    r->hdr->slots = slots;
+    r->hdr->slot_bytes = slot_bytes;
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+  }
+  return r;
+}
+
+// 0 ok; -1 timeout; -2 payload too large.
+int ring_push(void* handle, const uint8_t* buf, uint64_t len,
+              int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  if (len > r->hdr->slot_bytes) return -2;
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (head - tail < r->hdr->slots) {
+      uint8_t* p = slot_ptr(r, head);
+      std::memcpy(p, &len, 8);
+      std::memcpy(p + 8, buf, len);
+      r->hdr->head.store(head + 1, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return -1;
+    usleep(200);
+    waited_us += 200;
+  }
+}
+
+// >=0: payload length; -1 timeout; -2 caller buffer too small.
+int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
+                 int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (tail < head) {
+      uint8_t* p = slot_ptr(r, tail);
+      uint64_t len;
+      std::memcpy(&len, p, 8);
+      if (len > buf_len) return -2;
+      std::memcpy(buf, p + 8, len);
+      r->hdr->tail.store(tail + 1, std::memory_order_release);
+      return (int64_t)len;
+    }
+    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return -1;
+    usleep(200);
+    waited_us += 200;
+  }
+}
+
+// Number of filled slots (diagnostic).
+uint64_t ring_size(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  return r->hdr->head.load(std::memory_order_acquire) -
+         r->hdr->tail.load(std::memory_order_acquire);
+}
+
+void ring_close(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  munmap(r->hdr, r->map_bytes);
+  close(r->fd);
+  delete r;
+}
+
+int ring_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

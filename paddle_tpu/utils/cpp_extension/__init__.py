@@ -1,0 +1,93 @@
+"""paddle.utils.cpp_extension — build-and-load toolchain for native
+extensions.
+
+Parity target: python/paddle/utils/cpp_extension/ (setup/load compile
+custom C++ ops with the host toolchain and register them). TPU-native
+scope: native code here is HOST runtime code (data-loader transport,
+allocator-style utilities, custom CPython helpers) — device kernels
+are Pallas/XLA, so there is no nvcc path. Extensions expose a C ABI
+consumed via ctypes (the image ships no pybind11), and custom *ops*
+register through paddle_tpu.utils.custom_op which wraps a C kernel as
+a jax pure_callback op.
+
+`load(name, sources)` compiles once into a user cache dir keyed by a
+content hash, then dlopens — the reference's JIT `load()` contract.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+__all__ = ["load", "get_build_directory", "CppExtension", "setup"]
+
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+def get_build_directory():
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _content_key(sources, extra_cxx_flags):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cxx_flags).encode())
+    return h.hexdigest()[:16]
+
+
+def load(name, sources, extra_cxx_flags=None, extra_ldflags=None,
+         verbose=False):
+    """Compile `sources` into <cache>/<name>-<hash>.so with g++ and
+    return the ctypes.CDLL (reference cpp_extension.load)."""
+    extra_cxx_flags = list(extra_cxx_flags or [])
+    extra_ldflags = list(extra_ldflags or [])
+    key = (name, _content_key(sources, extra_cxx_flags + extra_ldflags))
+    with _lock:
+        if key in _loaded:
+            return _loaded[key]
+        so = os.path.join(get_build_directory(),
+                          f"{name}-{key[1]}.so")
+        if not os.path.exists(so):
+            cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+                   + extra_cxx_flags + list(sources) + ["-o", so + ".tmp"]
+                   + extra_ldflags)
+            if verbose:
+                print("cpp_extension:", " ".join(cmd))
+            res = subprocess.run(cmd, capture_output=True, text=True)
+            if res.returncode != 0:
+                raise RuntimeError(
+                    f"cpp_extension build of {name} failed:\n{res.stderr}")
+            os.replace(so + ".tmp", so)
+        lib = ctypes.CDLL(so)
+        _loaded[key] = lib
+        return lib
+
+
+class CppExtension:
+    """setup()-style extension description (source-compat shim over
+    load(); the reference's setuptools path)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Eagerly build all extensions (reference cpp_extension.setup —
+    here a direct build, no setuptools detour)."""
+    libs = []
+    for ext in ext_modules or []:
+        libs.append(load(name or "paddle_ext", ext.sources,
+                         **{k: v for k, v in ext.kwargs.items()
+                            if k in ("extra_cxx_flags", "extra_ldflags")}))
+    return libs
