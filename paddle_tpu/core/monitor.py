@@ -1,0 +1,107 @@
+"""Process-wide stat counters + VLOG (reference:
+paddle/fluid/platform/monitor.h:44 StatValue/StatRegistry with
+STAT_ADD:130, and glog VLOG levels with enforce.h error plumbing).
+
+TPU-native notes: device-memory counters the reference tracks by
+allocator hooks are read from PJRT memory stats when available."""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+__all__ = ["StatValue", "StatRegistry", "stat_add", "stat_get",
+           "stat_reset", "registry", "VLOG", "vlog_level",
+           "device_memory_stats"]
+
+
+class StatValue:
+    """Monotonic int counter (monitor.h:44)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def increase(self, n=1):
+        with self._lock:
+            self._v += n
+            return self._v
+
+    def decrease(self, n=1):
+        return self.increase(-n)
+
+    def reset(self):
+        with self._lock:
+            self._v = 0
+
+    def get(self):
+        with self._lock:
+            return self._v
+
+
+class StatRegistry:
+    def __init__(self):
+        self._stats = {}
+        self._lock = threading.Lock()
+
+    def get(self, name) -> StatValue:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = StatValue(name)
+            return self._stats[name]
+
+    def all(self):
+        with self._lock:
+            return {k: v.get() for k, v in self._stats.items()}
+
+
+registry = StatRegistry()
+
+
+def stat_add(name, n=1):
+    """STAT_ADD analog (monitor.h:130)."""
+    return registry.get(name).increase(n)
+
+
+def stat_get(name):
+    return registry.get(name).get()
+
+
+def stat_reset(name=None):
+    if name is None:
+        for v in list(registry._stats.values()):
+            v.reset()
+    else:
+        registry.get(name).reset()
+
+
+def device_memory_stats(device=None):
+    """Per-device memory stats from PJRT (the STAT_ADD(gpu_mem) analog
+    the reference maintains by allocator instrumentation)."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+# -- VLOG -------------------------------------------------------------------
+
+def vlog_level():
+    try:
+        return int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        return 0
+
+
+def VLOG(level, *msg):
+    """glog VLOG(level) << ... analog; enabled by GLOG_v env."""
+    if level <= vlog_level():
+        ts = time.strftime("%H:%M:%S")
+        print(f"V{level} {ts}]", *msg, file=sys.stderr)

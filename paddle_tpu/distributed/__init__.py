@@ -17,6 +17,8 @@ from .fleet import utils as _fleet_utils
 from .utils import global_scatter, global_gather
 from .spawn import spawn
 from . import sharding
+from . import auto_parallel
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op, reshard
 
 
 def get_backend():

@@ -1,0 +1,298 @@
+"""Elastic training (reference:
+python/paddle/distributed/fleet/elastic/manager.py:130 ElasticManager
++ elastic/__init__.py entry).
+
+The reference keeps cluster membership in etcd: each node holds a
+TTL-leased key refreshed by a heartbeat thread (`lease_heartbeat:250`),
+watches the node prefix for joins/leaves (`host_call_back:234`), and
+relaunches training with ELASTIC_EXIT_CODE when the world changes.
+
+TPU-native, etcd-less design: the same contract over a built-in TCP
+key-value store with TTL leases (`KVStore`/`KVClient` — the gloo-store
+analog this framework already needs for rendezvous). Fault-tolerance
+levels match the reference: 0 = fail fast, 1 = relaunch same world,
+2 = elastic scale in/out within [np_min, np_max].
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+__all__ = ["KVStore", "KVClient", "ElasticManager", "ElasticStatus",
+           "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # reference elastic/__init__.py:37
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+# ---------------------------------------------------------------------------
+# TCP KV store with TTL leases (etcd stand-in; line-oriented protocol)
+# ---------------------------------------------------------------------------
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.kv
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw.decode())
+                op = req["op"]
+                if op == "put":
+                    store._put(req["key"], req["value"],
+                               req.get("ttl", 0))
+                    resp = {"ok": True}
+                elif op == "get":
+                    resp = {"ok": True, "value": store._get(req["key"])}
+                elif op == "delete":
+                    store._delete(req["key"])
+                    resp = {"ok": True}
+                elif op == "list":
+                    resp = {"ok": True,
+                            "items": store._list(req["prefix"])}
+                elif op == "refresh":
+                    resp = {"ok": True,
+                            "value": store._refresh(req["key"],
+                                                    req.get("ttl", 0))}
+                else:
+                    resp = {"ok": False, "error": f"bad op {op}"}
+            except Exception as e:  # keep serving
+                resp = {"ok": False, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class KVStore:
+    """TTL-leased KV server (the etcd/gloo-HTTP-store analog)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._data = {}  # key -> (value, expire_ts or None)
+        self._lock = threading.Lock()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), _KVHandler)
+        self._server.kv = self
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def _expired(self, ent):
+        return ent[1] is not None and ent[1] < time.time()
+
+    def _put(self, key, value, ttl=0):
+        with self._lock:
+            self._data[key] = (value,
+                               time.time() + ttl if ttl else None)
+
+    def _get(self, key):
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None or self._expired(ent):
+                return None
+            return ent[0]
+
+    def _delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def _refresh(self, key, ttl=0):
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None or self._expired(ent):
+                return False
+            self._data[key] = (ent[0],
+                               time.time() + ttl if ttl else None)
+            return True
+
+    def _list(self, prefix):
+        with self._lock:
+            return {k: v[0] for k, v in self._data.items()
+                    if k.startswith(prefix) and not self._expired(v)}
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class KVClient:
+    def __init__(self, endpoint, timeout=5.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _conn(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout)
+            self._file = self._sock.makefile("rwb")
+        return self._file
+
+    def _call(self, req):
+        with self._lock:
+            try:
+                f = self._conn()
+                f.write((json.dumps(req) + "\n").encode())
+                f.flush()
+                resp = json.loads(f.readline().decode())
+            except (OSError, ValueError):
+                self.close()
+                raise
+        if not resp.get("ok"):
+            raise RuntimeError(f"kv store error: {resp.get('error')}")
+        return resp
+
+    def put(self, key, value, ttl=0):
+        self._call({"op": "put", "key": key, "value": value, "ttl": ttl})
+
+    def get(self, key):
+        return self._call({"op": "get", "key": key}).get("value")
+
+    def delete(self, key):
+        self._call({"op": "delete", "key": key})
+
+    def refresh(self, key, ttl=0):
+        return self._call({"op": "refresh", "key": key,
+                           "ttl": ttl}).get("value")
+
+    def list(self, prefix):
+        return self._call({"op": "list", "prefix": prefix})["items"]
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Elastic manager
+# ---------------------------------------------------------------------------
+
+class ElasticManager:
+    """Cluster membership + scale detection (reference manager.py:130).
+
+    - register(): write this node's key with a TTL lease and start the
+      heartbeat thread (reference lease_heartbeat:250);
+    - membership changes are detected by polling the node prefix
+      (reference watches etcd; polling an in-house store is the same
+      contract);
+    - need_scale()/wait_for_world(): elastic level 2 logic within
+      [np_min, np_max];
+    - exit code ELASTIC_EXIT_CODE tells the supervisor to relaunch.
+    """
+
+    def __init__(self, store_endpoint, job_id, host=None,
+                 np_min=1, np_max=None, ttl=6.0,
+                 elastic_level=1, heartbeat_interval=None):
+        self._kv = KVClient(store_endpoint)
+        self.job_id = job_id
+        self.host = host or socket.gethostname()
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.ttl = ttl
+        self.elastic_level = elastic_level
+        self._hb_interval = heartbeat_interval or max(ttl / 3, 0.5)
+        self._prefix = f"/paddle/{job_id}/nodes/"
+        self._key = self._prefix + self.host
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._last_world = None
+        self.enable = self.np_max > self.np_min or elastic_level > 0
+
+    # -- membership -------------------------------------------------------
+    def register(self):
+        self._kv.put(self._key, {"host": self.host,
+                                 "ts": time.time()}, ttl=self.ttl)
+        if self._hb_thread is None:
+            self._hb_thread = threading.Thread(target=self._heartbeat,
+                                               daemon=True)
+            self._hb_thread.start()
+        self._last_world = sorted(self.hosts())
+
+    def _heartbeat(self):
+        while not self._stop.wait(self._hb_interval):
+            try:
+                if not self._kv.refresh(self._key, ttl=self.ttl):
+                    # lease expired (e.g. long GC pause) — re-register
+                    self._kv.put(self._key,
+                                 {"host": self.host, "ts": time.time()},
+                                 ttl=self.ttl)
+            except Exception:
+                pass  # store briefly unreachable; retry next tick
+
+    def hosts(self):
+        return sorted(self._kv.list(self._prefix))
+
+    def world_size(self):
+        return len(self.hosts())
+
+    # -- scale logic ------------------------------------------------------
+    def need_scale(self):
+        """True when membership changed vs the registered snapshot."""
+        cur = self.hosts()
+        return self._last_world is not None and cur != self._last_world
+
+    def need_restart(self):
+        if not self.need_scale():
+            return False
+        n = self.world_size()
+        if self.elastic_level >= 2:
+            return self.np_min <= n <= self.np_max
+        # level 1: restart only when the original world is back
+        return n == len(self._last_world)
+
+    def wait_for_world(self, n=None, timeout=60.0):
+        """Block until the membership reaches n (default np_min)
+        healthy nodes; returns the host list."""
+        want = n or self.np_min
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            hosts = self.hosts()
+            if len(hosts) >= want:
+                return hosts
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"elastic: only {self.world_size()} of {want} nodes joined "
+            f"within {timeout}s")
+
+    def health(self):
+        """HOLD while the world is wrong; RESTART when a scale event
+        settled inside [np_min, np_max]; ERROR below np_min after a
+        loss; COMPLETED is the trainer's business."""
+        n = self.world_size()
+        if self.need_restart():
+            return ElasticStatus.RESTART
+        if n < self.np_min:
+            return (ElasticStatus.HOLD if self.elastic_level >= 1
+                    else ElasticStatus.ERROR)
+        return ElasticStatus.HOLD if self.need_scale() \
+            else ElasticStatus.COMPLETED
+
+    def exit(self):
+        self._stop.set()
+        try:
+            self._kv.delete(self._key)
+        except Exception:
+            pass
+        self._kv.close()

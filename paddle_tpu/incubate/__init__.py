@@ -2,3 +2,4 @@
 from . import nn
 from . import autograd
 from . import distributed
+from . import checkpoint

@@ -1,0 +1,2 @@
+"""paddle.incubate.checkpoint namespace."""
+from . import auto_checkpoint

@@ -1,0 +1,88 @@
+"""Auto checkpoint / resume (reference:
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+AutoCheckpointChecker:71 + train_epoch_range:598).
+
+Contract replicated: `for epoch in train_epoch_range(N): ...` is
+epoch-granular auto save/restore keyed by job id — on a fresh run it
+yields 0..N-1 and checkpoints registered models/optimizers each epoch;
+after a crash+relaunch with the same PADDLE_JOB_ID it restores state
+and resumes from the first incomplete epoch. The reference stores to
+HDFS; here the FS abstraction (fleet/utils/fs.py LocalFS) writes a
+local/NFS dir from PADDLE_CHECKPOINT_DIR."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["train_epoch_range", "register", "clear_registry",
+           "checkpoint_dir", "job_id", "save_checkpoint",
+           "load_checkpoint"]
+
+_registered = []  # (name, obj-with-state_dict/set_state_dict)
+
+
+def job_id():
+    return os.environ.get("PADDLE_JOB_ID", "default_job")
+
+
+def checkpoint_dir():
+    d = os.environ.get("PADDLE_CHECKPOINT_DIR",
+                       os.path.join(".", "auto_checkpoint"))
+    return os.path.join(d, job_id())
+
+
+def register(name, obj):
+    """Register a model/optimizer (anything with state_dict /
+    set_state_dict) for auto checkpointing."""
+    _registered.append((name, obj))
+    return obj
+
+
+def clear_registry():
+    _registered.clear()
+
+
+def _meta_path():
+    return os.path.join(checkpoint_dir(), "meta.json")
+
+
+def save_checkpoint(epoch):
+    from ... import framework
+
+    d = checkpoint_dir()
+    os.makedirs(d, exist_ok=True)
+    for name, obj in _registered:
+        framework.save(obj.state_dict(), os.path.join(d, name + ".pd"))
+    tmp = _meta_path() + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": epoch, "ts": time.time(),
+                   "names": [n for n, _ in _registered]}, f)
+    os.replace(tmp, _meta_path())  # atomic: crash-safe metadata
+
+
+def load_checkpoint():
+    """Returns the last completed epoch (or -1) after restoring the
+    registered objects."""
+    from ... import framework
+
+    if not os.path.exists(_meta_path()):
+        return -1
+    with open(_meta_path()) as f:
+        meta = json.load(f)
+    d = checkpoint_dir()
+    for name, obj in _registered:
+        p = os.path.join(d, name + ".pd")
+        if os.path.exists(p):
+            obj.set_state_dict(framework.load(p))
+    return int(meta.get("epoch", -1))
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1):
+    """reference train_epoch_range:598 — resumable epoch generator."""
+    last_done = load_checkpoint()
+    for epoch in range(last_done + 1, max_epoch_num):
+        yield epoch
+        if (epoch + 1) % max(save_checkpoint_inter, 1) == 0 \
+                or epoch == max_epoch_num - 1:
+            save_checkpoint(epoch)

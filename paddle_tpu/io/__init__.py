@@ -382,19 +382,32 @@ class DataLoader:
                 slot_mb, self.worker_init_fn, self.timeout,
                 self.persistent_workers,
                 iterable_mode=self._iterable_mode,
-                batch_size=self.batch_size or 1,
+                batch_size=self.batch_size,
                 drop_last=self.drop_last)
 
-        if self.persistent_workers:
-            # one long-lived worker pool; run_epoch serializes epochs
-            # (a second concurrent iterator raises)
-            if self._mp_loader is None:
-                self._mp_loader = make_loader()
-            loader, owned = self._mp_loader, False
-        else:
-            # each iterator owns an independent pool — concurrent
-            # iterators (zip(dl, dl)) cannot corrupt each other
-            loader, owned = make_loader(), True
+        try:
+            if self.persistent_workers:
+                # one long-lived worker pool; run_epoch serializes
+                # epochs (a second concurrent iterator raises). A pool
+                # torn down by a worker error/timeout is rebuilt.
+                if self._mp_loader is None or not self._mp_loader.procs:
+                    self._mp_loader = make_loader()
+                loader, owned = self._mp_loader, False
+            else:
+                # each iterator owns an independent pool — concurrent
+                # iterators (zip(dl, dl)) cannot corrupt each other
+                loader, owned = make_loader(), True
+        except (RuntimeError, OSError, FileNotFoundError) as e:
+            # shared-memory transport unavailable (no g++ / read-only
+            # cache dir): fall back to the threaded prefetcher
+            import warnings
+
+            warnings.warn(
+                f"multiprocess DataLoader unavailable ({e}); falling "
+                "back to thread prefetching — pass "
+                "use_shared_memory=False to silence", RuntimeWarning)
+            yield from self._threaded_iter()
+            return
 
         if self.batch_sampler is not None:
             batches = iter(self.batch_sampler)
@@ -412,15 +425,9 @@ class DataLoader:
             if owned:
                 loader.shutdown()
 
-    def __iter__(self):
-        if self.num_workers <= 0:
-            yield from self._iter_batches()
-            return
-        if self.use_shared_memory:
-            yield from self._multiprocess_iter()
-            return
-        # threaded prefetch fallback: producer thread pulls batches,
-        # main thread does device_put
+    def _threaded_iter(self):
+        # threaded prefetch: producer thread pulls batches, main
+        # thread does device_put
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
@@ -438,3 +445,12 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._iter_batches()
+            return
+        if self.use_shared_memory:
+            yield from self._multiprocess_iter()
+            return
+        yield from self._threaded_iter()

@@ -146,13 +146,19 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                 try:
                     it = itertools.islice(iter(dataset), worker_id, None,
                                           num_workers)
-                    while True:
-                        batch = list(itertools.islice(it, batch_size))
-                        if not batch or (len(batch) < batch_size
-                                         and drop_last):
-                            break
-                        ring.push(pickle.dumps(collate_fn(batch),
-                                               protocol=5))
+                    if batch_size is None:
+                        # batch_size=None: raw per-sample values, no
+                        # collate (matches the single-process path)
+                        for sample in it:
+                            ring.push(pickle.dumps(sample, protocol=5))
+                    else:
+                        while True:
+                            batch = list(itertools.islice(it, batch_size))
+                            if not batch or (len(batch) < batch_size
+                                             and drop_last):
+                                break
+                            ring.push(pickle.dumps(collate_fn(batch),
+                                                   protocol=5))
                 except Exception as e:
                     import traceback
 

@@ -58,8 +58,11 @@ def load(name, sources, extra_cxx_flags=None, extra_ldflags=None,
         so = os.path.join(get_build_directory(),
                           f"{name}-{key[1]}.so")
         if not os.path.exists(so):
+            # per-process tmp name: concurrent trainers cold-building
+            # the same extension each publish atomically via replace
+            tmp = f"{so}.{os.getpid()}.tmp"
             cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
-                   + extra_cxx_flags + list(sources) + ["-o", so + ".tmp"]
+                   + extra_cxx_flags + list(sources) + ["-o", tmp]
                    + extra_ldflags)
             if verbose:
                 print("cpp_extension:", " ".join(cmd))
@@ -67,7 +70,7 @@ def load(name, sources, extra_cxx_flags=None, extra_ldflags=None,
             if res.returncode != 0:
                 raise RuntimeError(
                     f"cpp_extension build of {name} failed:\n{res.stderr}")
-            os.replace(so + ".tmp", so)
+            os.replace(tmp, so)
         lib = ctypes.CDLL(so)
         _loaded[key] = lib
         return lib

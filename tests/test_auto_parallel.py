@@ -1,0 +1,98 @@
+"""Auto-parallel user API (reference:
+python/paddle/distributed/auto_parallel/ — ProcessMesh, shard_tensor
+dims_mapping, reshard, Engine) on the 8-virtual-CPU mesh."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.distributed import set_mesh
+from paddle_tpu.distributed.auto_parallel import (
+    Engine, ProcessMesh, reshard, set_default_process_mesh, shard_op,
+    shard_tensor)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    set_mesh(None)
+    set_default_process_mesh.__globals__["_default_process_mesh"] = None
+
+
+def test_process_mesh_shape_and_jax_mesh():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    assert pm.shape == [2, 4]
+    jm = pm.get_mesh()
+    assert jm.shape == {"dp": 2, "mp": 4}
+
+
+def test_shard_tensor_places_array_shard_spec():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = paddle.to_tensor(np.ones((4, 8), np.float32))
+    shard_tensor(t, pm, shard_spec=["x", "y"])
+    shard_shapes = {tuple(s.data.shape)
+                    for s in t._value.addressable_shards}
+    assert shard_shapes == {(2, 2)}
+
+
+def test_shard_tensor_dims_mapping_v22_style():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = paddle.to_tensor(np.ones((8, 6), np.float32))
+    # dims_mapping: dim0 -> mesh dim 0 ('x'), dim1 replicated
+    shard_tensor(t, dist_attr={"process_mesh": pm,
+                               "dims_mapping": [0, -1]})
+    shard_shapes = {tuple(s.data.shape)
+                    for s in t._value.addressable_shards}
+    assert shard_shapes == {(4, 6)}
+
+
+def test_reshard_changes_placement():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    shard_tensor(t, pm, shard_spec=["x", None])
+    before = np.asarray(t._value)
+    reshard(t, pm, shard_spec=[None, "y"])
+    np.testing.assert_array_equal(np.asarray(t._value), before)
+    shard_shapes = {tuple(s.data.shape)
+                    for s in t._value.addressable_shards}
+    assert shard_shapes == {(8, 1)}
+
+
+def test_shard_op_constrains_output():
+    pm = ProcessMesh(np.arange(8).reshape(8), dim_names=["x"])
+    set_default_process_mesh(pm)
+    matmul = shard_op(paddle.matmul, pm,
+                      out_shard_specs=[["x", None]])
+    a = paddle.to_tensor(np.ones((8, 4), np.float32))
+    b = paddle.to_tensor(np.ones((4, 4), np.float32))
+    out = matmul(a, b)
+    np.testing.assert_allclose(np.asarray(out._value), 4.0)
+
+
+def test_engine_fit_decreases_loss():
+    from paddle_tpu.io import Dataset
+
+    pm = ProcessMesh(np.arange(8).reshape(8), dim_names=["dp"])
+    set_default_process_mesh(pm)
+
+    class Reg(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(64, 16).astype(np.float32)
+            self.y = (self.x @ rng.randn(16, 1).astype(np.float32))
+
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    model = nn.Linear(16, 1)
+    eng = Engine(model=model,
+                 loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=optim.Adam(learning_rate=1e-2,
+                                      parameters=model.parameters()))
+    hist = eng.fit(Reg(), epochs=3, batch_size=16)
+    assert hist[-1] < hist[0]

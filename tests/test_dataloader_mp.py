@@ -52,20 +52,21 @@ def test_mp_loader_persistent_workers_two_epochs():
     dl._mp_loader.shutdown()
 
 
-def test_mp_loader_worker_init_fn():
-    calls = []
+def test_mp_loader_worker_init_fn(tmp_path):
+    marker_dir = str(tmp_path)
 
     def init_fn(worker_id):
-        # runs in the CHILD; write a marker the parent can observe via
-        # the data itself
-        import os
-
-        os.environ["PD_WORKER_MARK"] = str(worker_id)
+        # runs in the CHILD; leave a marker file the parent asserts on
+        open(f"{marker_dir}/worker_{worker_id}.ran", "w").write("1")
 
     ds = SquareDataset(16)
     dl = DataLoader(ds, batch_size=4, num_workers=2,
                     worker_init_fn=init_fn)
     assert sum(1 for _ in dl) == 4
+    import os
+
+    ran = sorted(os.listdir(marker_dir))
+    assert ran == ["worker_0.ran", "worker_1.ran"]
 
 
 def test_mp_loader_worker_exception_propagates():
@@ -96,6 +97,56 @@ def test_mp_loader_iterable_dataset():
                   for v in np.asarray(b._value)[:, 0])
     assert len(vals) >= 16  # all full batches across worker shards
     assert set(vals).issubset(set(range(20)))
+
+
+def test_mp_loader_iterable_batch_size_none_raw_samples():
+    """batch_size=None on an IterableDataset must yield raw sample
+    shapes, same as the single-process path (round-2 review)."""
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(6):
+                yield np.full((2,), i, np.float32)
+
+    single = [np.asarray(b._value) for b in
+              DataLoader(Stream(), batch_size=None, num_workers=0)]
+    multi = [np.asarray(b._value) for b in
+             DataLoader(Stream(), batch_size=None, num_workers=2)]
+    assert all(s.shape == (2,) for s in single)
+    assert all(m.shape == (2,) for m in multi)
+    assert sorted(m[0] for m in multi) == sorted(s[0] for s in single)
+
+
+def test_mp_loader_persistent_pool_rebuilt_after_error():
+    """After a worker error tears the pool down, the next iteration
+    over a persistent DataLoader rebuilds it (round-2 review)."""
+    class FlakyDataset(Dataset):
+        def __init__(self):
+            self.fail = True
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            import os
+
+            if os.environ.get("PD_FLAKY_FAIL") == "1" and i == 3:
+                raise ValueError("flaky")
+            return np.zeros((2,), np.float32)
+
+    import os
+
+    dl = DataLoader(FlakyDataset(), batch_size=2, num_workers=2,
+                    persistent_workers=True)
+    os.environ["PD_FLAKY_FAIL"] = "1"
+    with pytest.raises(RuntimeError, match="flaky"):
+        list(dl)
+    os.environ["PD_FLAKY_FAIL"] = "0"
+    try:
+        assert sum(1 for _ in dl) == 4  # pool rebuilt, clean epoch
+    finally:
+        os.environ.pop("PD_FLAKY_FAIL", None)
+        if dl._mp_loader is not None:
+            dl._mp_loader.shutdown()
 
 
 def test_mp_loader_batch_size_none_yields_samples():

@@ -1,0 +1,91 @@
+"""Elastic manager + TTL KV store (reference:
+fleet/elastic/manager.py:130; store = etcd stand-in)."""
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, KVClient, KVStore)
+
+
+@pytest.fixture()
+def store():
+    s = KVStore()
+    yield s
+    s.close()
+
+
+def test_kv_store_put_get_list_delete(store):
+    c = KVClient(store.endpoint)
+    c.put("/a/x", {"v": 1})
+    c.put("/a/y", {"v": 2})
+    c.put("/b/z", {"v": 3})
+    assert c.get("/a/x") == {"v": 1}
+    assert set(c.list("/a/")) == {"/a/x", "/a/y"}
+    c.delete("/a/x")
+    assert c.get("/a/x") is None
+    c.close()
+
+
+def test_kv_store_ttl_expiry_and_refresh(store):
+    c = KVClient(store.endpoint)
+    c.put("/lease/n1", "alive", ttl=0.4)
+    assert c.get("/lease/n1") == "alive"
+    assert c.refresh("/lease/n1", ttl=0.4)
+    time.sleep(0.6)
+    assert c.get("/lease/n1") is None
+    assert not c.refresh("/lease/n1", ttl=0.4)
+    c.close()
+
+
+def test_manager_register_and_heartbeat_keeps_alive(store):
+    m = ElasticManager(store.endpoint, "job1", host="n0", ttl=0.5)
+    m.register()
+    time.sleep(1.2)  # several lease periods — heartbeat must refresh
+    assert m.world_size() == 1
+    m.exit()
+    assert m.world_size() == 0
+
+
+def test_manager_detects_scale_out_and_restart(store):
+    m0 = ElasticManager(store.endpoint, "j", host="n0", np_min=1,
+                        np_max=3, ttl=2.0, elastic_level=2)
+    m0.register()
+    assert not m0.need_scale()
+    m1 = ElasticManager(store.endpoint, "j", host="n1", np_min=1,
+                        np_max=3, ttl=2.0, elastic_level=2)
+    m1.register()
+    assert m0.need_scale()
+    assert m0.need_restart()  # 2 in [1, 3]
+    assert m0.health() == ElasticStatus.RESTART
+    m0.exit()
+    m1.exit()
+
+
+def test_manager_node_death_detected_via_lease(store):
+    m0 = ElasticManager(store.endpoint, "j2", host="n0", np_min=2,
+                        np_max=2, ttl=3.0, elastic_level=1)
+    dead = ElasticManager(store.endpoint, "j2", host="n1", np_min=2,
+                          np_max=2, ttl=0.4, elastic_level=1)
+    # dead node: lease placed once, NO heartbeat (simulate crash)
+    dead._kv.put(dead._key, {"host": "n1"}, ttl=0.4)
+    m0.register()
+    assert m0.world_size() == 2
+    time.sleep(0.8)  # n1's lease expires
+    assert m0.world_size() == 1
+    assert m0.need_scale()
+    # level 1 with world below np_min: hold for relaunch, not restart
+    assert m0.health() == ElasticStatus.HOLD
+    m0.exit()
+
+
+def test_wait_for_world_timeout(store):
+    m = ElasticManager(store.endpoint, "j3", host="n0", np_min=2)
+    m.register()
+    with pytest.raises(TimeoutError):
+        m.wait_for_world(2, timeout=0.5)
+    m.exit()
+
+
+def test_elastic_exit_code_constant():
+    assert ELASTIC_EXIT_CODE == 101
