@@ -55,6 +55,8 @@ from . import text
 from . import hub
 from . import onnx
 from . import sparse
+from . import quantization
+from . import utils
 from . import linalg as _linalg_ns
 from . import fft
 from . import signal

@@ -3,3 +3,4 @@ from . import nn
 from . import autograd
 from . import distributed
 from . import checkpoint
+from . import asp

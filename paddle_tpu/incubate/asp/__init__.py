@@ -1,0 +1,164 @@
+"""paddle.incubate.asp — automatic 2:4 structured sparsity.
+
+Parity target: python/paddle/fluid/contrib/sparsity/asp.py
+(prune_model, decorate/OptimizerWithSparsityGuarantee, set_excluded_
+layers, calculate_density) + utils.py mask algorithms (mask_1d /
+best-of-permutations n:m masks).
+
+TPU-native notes: the reference exploits Ampere sparse tensor cores;
+TPU MXUs have no 2:4 hardware path, so the capability here is the
+TRAINING workflow — n:m masks computed along the REDUCTION (K) dim of
+each GEMM (Linear [in, out] masks down columns; Conv masks the
+flattened in*kh*kw dim per output channel — the reference reshapes
+conv weights to 2D the same way), applied at prune time and re-applied
+after every optimizer step (the sparsity-guarantee contract) — so
+checkpoints carry hardware-valid 2:4 patterns."""
+from __future__ import annotations
+
+import warnings
+import weakref
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["prune_model", "decorate", "calculate_density",
+           "set_excluded_layers", "reset_excluded_layers",
+           "create_mask", "check_mask_1d"]
+
+_excluded = set()
+# id(param) -> (weakref(param), mask). Weak so pruned models can be
+# collected; decorate() snapshots only ITS optimizer's params.
+_masks: dict = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _mask_last_axis(flat2d, n, m):
+    """[rows, K] -> n:m mask along K (keep the n largest |w| per
+    m-group)."""
+    groups = np.abs(flat2d).reshape(-1, m)
+    drop = np.argsort(groups, axis=1)[:, : m - n]
+    mask = np.ones_like(groups)
+    np.put_along_axis(mask, drop, 0.0, axis=1)
+    return mask.reshape(flat2d.shape)
+
+
+def create_mask(w, n=2, m=4):
+    """n:m mask along the GEMM reduction dim (reference
+    sparsity/utils.py get_mask_1d + asp.py's conv reshape):
+    - 2-D [in, out] (Linear, y = xW): groups run down axis 0, per
+      output column;
+    - 4-D [out, in, kh, kw] (Conv): flattened to [out, in*kh*kw],
+      groups along the flattened reduction.
+    Returns None when the reduction dim is not divisible by m."""
+    w = np.asarray(w)
+    if w.ndim == 2:
+        if w.shape[0] % m:
+            return None
+        return _mask_last_axis(w.T, n, m).T.astype(w.dtype)
+    if w.ndim == 4:
+        out_c = w.shape[0]
+        k = int(np.prod(w.shape[1:]))
+        if k % m:
+            return None
+        return _mask_last_axis(w.reshape(out_c, k), n, m).reshape(
+            w.shape).astype(w.dtype)
+    if w.shape[-1] % m:
+        return None
+    return _mask_last_axis(w.reshape(-1, w.shape[-1]), n, m).reshape(
+        w.shape).astype(w.dtype)
+
+
+def check_mask_1d(mat, n=2, m=4):
+    """True iff every m-group along the reduction dim has <= n
+    nonzeros (same axis convention as create_mask)."""
+    mat = np.asarray(mat)
+    if mat.ndim == 2:
+        view = mat.T
+    elif mat.ndim == 4:
+        view = mat.reshape(mat.shape[0], -1)
+    else:
+        view = mat.reshape(-1, mat.shape[-1])
+    if view.shape[-1] % m:
+        return False
+    groups = (view.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def calculate_density(tensor):
+    arr = np.asarray(getattr(tensor, "_value", tensor))
+    return float((arr != 0).sum() / arr.size)
+
+
+def _prunable_params(model):
+    from ...nn import Conv2D, Linear
+
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (Linear, Conv2D)):
+            for name, p in layer.named_parameters(include_sublayers=False):
+                if "weight" in name and p.name not in _excluded \
+                        and len(p.shape) >= 2:
+                    yield p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute + apply n:m masks to every prunable weight (reference
+    asp.py prune_model). Masks are remembered (weakly) so `decorate`d
+    optimizers re-apply them after each step."""
+    pruned = {}
+    for p in _prunable_params(model):
+        mask = create_mask(np.asarray(p._value), n=n, m=m)
+        if mask is None:
+            warnings.warn(
+                f"asp: weight {p.name or id(p)} shape {tuple(p.shape)} "
+                f"has a reduction dim not divisible by {m}; left dense")
+            continue
+        p._value = (jnp.asarray(p._value) * jnp.asarray(mask))
+        if with_mask:
+            _masks[id(p)] = (weakref.ref(p), mask)
+        pruned[p.name or str(id(p))] = mask
+    # purge entries whose params were collected
+    for k in [k for k, (r, _) in _masks.items() if r() is None]:
+        del _masks[k]
+    return pruned
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference asp.py:OptimizerWithSparsityGuarantee — masks are
+    re-applied after every optimizer step so pruned weights stay 0
+    through training. Only THIS optimizer's parameters are touched."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        param_ids = {id(p) for p in
+                     (getattr(optimizer, "_parameter_list", None) or [])}
+        self._mine = [(r, m) for pid, (r, m) in _masks.items()
+                      if not param_ids or pid in param_ids]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _reapply(self):
+        for ref, mask in self._mine:
+            p = ref()
+            if p is not None:
+                p._value = jnp.asarray(p._value) * jnp.asarray(mask)
+
+    def step(self):
+        self._inner.step()
+        self._reapply()
+
+    def minimize(self, loss, *a, **kw):
+        out = self._inner.minimize(loss, *a, **kw)
+        self._reapply()
+        return out
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,282 @@
+"""paddle.quantization — QAT + PTQ.
+
+Parity target: python/paddle/fluid/contrib/slim/quantization/
+(`imperative/qat.py` ImperativeQuantAware — dygraph QAT with fake
+quant ops; `post_training_quantization.py` — calibration-based PTQ)
+over the fake_quantize_* / moving_average_abs_max CUDA ops
+(paddle/fluid/operators/fake_quantize_op.cc).
+
+TPU-native design: fake-quant is a pure jax kernel with a
+straight-through estimator (`x + stop_gradient(q - x)`) — XLA fuses it
+into the surrounding matmul, no custom op registration needed. Weight
+quant is per-output-channel abs-max (channel_wise_abs_max); activation
+quant keeps a moving-average abs-max scale in a layer buffer updated
+through the same buffer-scope mechanism BatchNorm's running stats use,
+so QAT trains inside compiled steps. PTQ converts Linear weights to
+stored int8 + scale; dequantization happens in-graph (weight-only
+int8, the TPU-serving pattern)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.engine import apply_op, in_trace_mode
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quantize", "ImperativeQuantAware", "QuantedLinear",
+           "QuantedConv2D", "PostTrainingQuantization",
+           "quant_post_dynamic", "QuantConfig"]
+
+
+def _k_fake_quant(x, scale, bits):
+    """Symmetric fake quant with STE. scale: per-channel (broadcast
+    against x's last dim for weights) or scalar (activations)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8) / qmax
+    q = jnp.clip(jnp.round(x / s), -qmax - 1, qmax) * s
+    return x + lax.stop_gradient(q - x)
+
+
+def fake_quantize(x, scale, bits=8):
+    return apply_op("fake_quantize", _k_fake_quant, x, scale, bits=bits)
+
+
+def _abs_max_per_channel(w, channel_axis):
+    red = tuple(i for i in range(w.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(w), axis=red, keepdims=True)
+
+
+class QuantConfig:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+
+
+class _QuantedBase(Layer):
+    """Shares the wrapped layer's parameters; adds the activation
+    moving-average scale buffer (fake_quantize_moving_average_abs_max
+    analog)."""
+
+    def __init__(self, layer, cfg: QuantConfig):
+        super().__init__()
+        self._inner = layer
+        self._cfg = cfg
+        for name, p in layer.named_parameters():
+            self.add_parameter(name.replace(".", "_"), p)
+        self.register_buffer("_act_scale", Tensor(
+            jnp.ones((), jnp.float32), stop_gradient=True))
+
+    def _quant_act(self, x):
+        cfg = self._cfg
+        if not self.training:
+            # eval: fixed stored scale, no stat ops
+            return fake_quantize(x, self._act_scale,
+                                 bits=cfg.activation_bits)
+        cur = apply_op("abs_max", lambda v: jnp.max(jnp.abs(v)), x)
+        rate = cfg.moving_rate
+        new_scale = apply_op(
+            "ma_scale",
+            lambda s, c: rate * s + (1 - rate) * c,
+            self._act_scale, cur)
+        if not in_trace_mode():
+            self._act_scale._value = new_scale._value
+        else:
+            from ..jit.state import record_buffer_update
+
+            record_buffer_update(self._act_scale, new_scale)
+        return fake_quantize(x, new_scale, bits=cfg.activation_bits)
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        inner, cfg = self._inner, self._cfg
+        x = self._quant_act(x)
+        w = inner.weight  # [in, out]
+        wscale = apply_op("wscale", _abs_max_per_channel, w,
+                          channel_axis=1)
+        wq = fake_quantize(w, wscale, bits=cfg.weight_bits)
+        out = x @ wq
+        if getattr(inner, "bias", None) is not None:
+            out = out + inner.bias
+        return out
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        from ..nn import functional as F
+
+        inner, cfg = self._inner, self._cfg
+        x = self._quant_act(x)
+        w = inner.weight  # [out_c, in_c, kh, kw]
+        wscale = apply_op("wscale", _abs_max_per_channel, w,
+                          channel_axis=0)
+        wq = fake_quantize(w, wscale, bits=cfg.weight_bits)
+        return F.conv2d(x, wq, bias=getattr(inner, "bias", None),
+                        stride=inner._stride, padding=inner._padding,
+                        dilation=inner._dilation, groups=inner._groups)
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT (reference imperative/qat.py:ImperativeQuantAware):
+    `quantize(model)` swaps Linear/Conv2D sublayers for fake-quant
+    wrappers IN PLACE; train as usual; `save_quantized_model` exports
+    the fake-quant graph via jit.save."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        self._types = tuple(quantizable_layer_type)
+        self._cfg = QuantConfig(weight_bits, activation_bits, moving_rate,
+                                weight_quantize_type,
+                                activation_quantize_type)
+
+    def _wrap(self, layer):
+        from ..nn import Conv2D, Linear
+
+        if isinstance(layer, Linear) and "Linear" in self._types:
+            return QuantedLinear(layer, self._cfg)
+        if isinstance(layer, Conv2D) and "Conv2D" in self._types:
+            return QuantedConv2D(layer, self._cfg)
+        return None
+
+    def quantize(self, model):
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(
+                    getattr(parent, "_sub_layers", {}).items()):
+                q = self._wrap(child)
+                if q is not None:
+                    parent._sub_layers[name] = q
+                    setattr(parent, name, q)
+        return model
+
+    def save_quantized_model(self, layer, path, input_spec=None):
+        from ..jit import save as jit_save
+
+        jit_save(layer, path, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------------
+# PTQ
+# ---------------------------------------------------------------------------
+
+class Int8Linear(Layer):
+    """int8 linear. Weight-only mode (act_scale None): int8 weights +
+    per-channel scale dequantized in-graph (TPU-serving weight-only
+    pattern). Static mode (calibrated act_scale): activations quantize
+    to int8 too and the matmul runs int8 x int8 with int32
+    accumulation — the full reference PTQ numerics."""
+
+    def __init__(self, w_int8, scale, bias, act_scale=None, bits=8):
+        super().__init__()
+        self.register_buffer("w_int8", Tensor(w_int8, stop_gradient=True))
+        self.register_buffer("scale", Tensor(scale, stop_gradient=True))
+        self._bias = bias
+        self._act_scale = float(act_scale) if act_scale else None
+        self._qmax = float(2 ** (bits - 1) - 1)
+
+    def forward(self, x):
+        act_s = self._act_scale
+        qmax = self._qmax
+
+        def _k(xv, wq, s, b):
+            if act_s is not None:
+                sx = max(act_s, 1e-8) / qmax
+                xq = jnp.clip(jnp.round(xv / sx), -qmax - 1,
+                              qmax).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                y = acc.astype(jnp.float32) * (sx * s)
+            else:
+                w = wq.astype(jnp.float32) * s
+                y = xv @ w.astype(xv.dtype)
+            return y if b is None else y + b
+
+        return apply_op("int8_linear", _k, x, self.w_int8, self.scale,
+                        self._bias)
+
+
+class PostTrainingQuantization:
+    """PTQ (reference post_training_quantization.py): calibration
+    batches run with forward-pre-hooks on each Linear recording input
+    abs-max; convert() then emits Int8Linear layers whose activation
+    scales come from those stats (static int8) — without calibration,
+    weight-only int8."""
+
+    def __init__(self, model, quantizable_layer_type=("Linear",),
+                 weight_bits=8, algo="abs_max"):
+        self._model = model
+        self._types = quantizable_layer_type
+        self._bits = weight_bits
+        self._algo = algo
+        self._act_stats = {}  # id(layer) -> max |input|
+
+    def quantize(self, calib_reader=None, batch_nums=None):
+        """Collect activation stats (optional) and convert weights."""
+        if calib_reader is not None:
+            handles = []
+            from ..nn import Linear
+
+            def make_hook(layer):
+                def hook(lay, inputs):
+                    x = inputs[0]
+                    v = float(np.max(np.abs(np.asarray(
+                        getattr(x, "_value", x)))))
+                    key = id(layer)
+                    self._act_stats[key] = max(
+                        self._act_stats.get(key, 0.0), v)
+                    return None
+
+                return hook
+
+            for lay in self._model.sublayers(include_self=True):
+                if isinstance(lay, Linear) and "Linear" in self._types:
+                    handles.append(
+                        lay.register_forward_pre_hook(make_hook(lay)))
+            try:
+                for i, batch in enumerate(calib_reader):
+                    if batch_nums is not None and i >= batch_nums:
+                        break
+                    x = (batch[0] if isinstance(batch, (list, tuple))
+                         else batch)
+                    self._model(x)
+            finally:
+                for h in handles:
+                    h.remove()
+        return self.convert()
+
+    def convert(self):
+        from ..nn import Linear
+
+        qmax = 2 ** (self._bits - 1) - 1
+        for parent in self._model.sublayers(include_self=True):
+            for name, child in list(
+                    getattr(parent, "_sub_layers", {}).items()):
+                if isinstance(child, Linear) and "Linear" in self._types:
+                    w = np.asarray(child.weight._value)
+                    scale = np.maximum(
+                        np.abs(w).max(axis=0, keepdims=True), 1e-8) / qmax
+                    w_int8 = np.clip(np.round(w / scale), -qmax - 1,
+                                     qmax).astype(np.int8)
+                    q = Int8Linear(w_int8, scale.astype(np.float32),
+                                   getattr(child, "bias", None),
+                                   act_scale=self._act_stats.get(
+                                       id(child)), bits=self._bits)
+                    parent._sub_layers[name] = q
+                    setattr(parent, name, q)
+        return self._model
+
+
+def quant_post_dynamic(model, **kw):
+    """Weight-only dynamic PTQ, one call (modern paddle alias)."""
+    return PostTrainingQuantization(model, **kw).convert()

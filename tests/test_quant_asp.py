@@ -1,0 +1,167 @@
+"""Quantization (QAT fake-quant STE, PTQ int8) + ASP 2:4 sparsity
+(reference: contrib/slim/quantization imperative/qat.py,
+post_training_quantization.py; contrib/sparsity/asp.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     PostTrainingQuantization,
+                                     QuantedLinear, fake_quantize,
+                                     quant_post_dynamic)
+
+
+def test_fake_quantize_values_and_ste_grad():
+    x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32))
+    x.stop_gradient = False
+    y = fake_quantize(x, paddle.to_tensor(np.float32(1.0)), bits=8)
+    # quantized to the 127-level grid
+    grid = np.round(np.asarray(y._value) * 127)
+    np.testing.assert_allclose(np.asarray(y._value), grid / 127,
+                               atol=1e-6)
+    # straight-through estimator: gradient of sum == 1 everywhere
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 1.0)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_qat_quantize_swaps_layers_and_trains():
+    m = _mlp(1)
+    quanter = ImperativeQuantAware()
+    quanter.quantize(m)
+    assert isinstance(m[0], QuantedLinear)
+    assert isinstance(m[2], QuantedLinear)
+    opt = optim.Adam(learning_rate=1e-2, parameters=m.parameters())
+    ce = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype(np.int64))
+    losses = []
+    for _ in range(15):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+    # activation scale buffer moved off its init value
+    assert float(m[0]._act_scale.item()) != 1.0
+
+
+def test_qat_trains_in_compiled_step():
+    from paddle_tpu.jit import TrainStepCompiler
+
+    m = _mlp(2)
+    ImperativeQuantAware().quantize(m)
+    opt = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+    ce = nn.CrossEntropyLoss()
+    step = TrainStepCompiler(m, opt, loss_fn=lambda o, y: ce(o, y))
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.int64)
+    l0 = float(step(x, y).item())
+    for _ in range(10):
+        l = float(step(x, y).item())
+    assert l < l0
+
+
+def test_ptq_int8_close_to_fp32():
+    m = _mlp(3)
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    ref = np.asarray(m(x)._value)
+    qm = quant_post_dynamic(m)
+    out = np.asarray(qm(x)._value)
+    # int8 weight-only: small relative error
+    assert np.max(np.abs(out - ref)) < 0.1 * (np.abs(ref).max() + 1)
+    from paddle_tpu.quantization import Int8Linear
+
+    assert isinstance(qm[0], Int8Linear)
+    assert qm[0].w_int8._value.dtype == np.int8
+
+
+def test_ptq_with_calibration_reader():
+    m = _mlp(4)
+    rng = np.random.RandomState(3)
+    calib = [(paddle.to_tensor(rng.randn(4, 16).astype(np.float32)),)
+             for _ in range(3)]
+    ptq = PostTrainingQuantization(m)
+    qm = ptq.quantize(calib_reader=calib, batch_nums=2)
+    x = paddle.to_tensor(rng.randn(4, 16).astype(np.float32))
+    assert np.asarray(qm(x)._value).shape == (4, 4)
+
+
+def test_asp_mask_2_4_and_density():
+    """Masks run along the GEMM reduction dim: for Linear [in, out]
+    that's axis 0 (per output column) — the pattern sparse GEMM
+    hardware requires."""
+    w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    mask = asp.create_mask(w, n=2, m=4)
+    assert asp.check_mask_1d(mask, 2, 4)
+    assert mask.sum() == w.size / 2
+    # the kept entries are the 2 largest |w| per group down each column
+    grp = (np.abs(w).T.reshape(-1, 4), mask.T.reshape(-1, 4))
+    for g, gm in zip(*grp):
+        kept = set(np.where(gm == 1)[0])
+        top2 = set(np.argsort(g)[-2:])
+        assert kept == top2
+
+
+def test_asp_conv_weight_masked_via_2d_reshape():
+    w = np.random.RandomState(1).randn(8, 4, 3, 3).astype(np.float32)
+    mask = asp.create_mask(w, n=2, m=4)  # in*kh*kw = 36, divisible
+    assert mask is not None
+    assert asp.check_mask_1d(mask, 2, 4)
+    assert mask.sum() == w.size / 2
+
+
+def test_asp_indivisible_reduction_left_dense_with_warning():
+    import paddle_tpu.nn as nn2
+
+    m = nn2.Linear(7, 8)  # reduction dim 7 % 4 != 0
+    with pytest.warns(UserWarning, match="not divisible"):
+        pruned = asp.prune_model(m)
+    assert pruned == {} or all("7" not in k for k in pruned)
+    assert asp.calculate_density(m.weight) == 1.0
+
+
+def test_ptq_static_uses_calibrated_act_scale():
+    from paddle_tpu.quantization import Int8Linear
+
+    m = _mlp(7)
+    rng = np.random.RandomState(5)
+    calib = [(paddle.to_tensor(rng.randn(4, 16).astype(np.float32)),)
+             for _ in range(3)]
+    qm = PostTrainingQuantization(m).quantize(calib_reader=calib)
+    assert isinstance(qm[0], Int8Linear)
+    assert qm[0]._act_scale is not None and qm[0]._act_scale > 0
+    x = paddle.to_tensor(rng.randn(4, 16).astype(np.float32))
+    out = np.asarray(qm(x)._value)
+    assert np.isfinite(out).all()
+
+
+def test_asp_prune_model_and_sparsity_guarantee():
+    m = _mlp(5)
+    asp.prune_model(m)
+    assert asp.calculate_density(m[0].weight) == pytest.approx(0.5)
+    opt = asp.decorate(optim.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()))
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+    ce = nn.CrossEntropyLoss()
+    for _ in range(3):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after training steps the 2:4 pattern survives
+    assert asp.check_mask_1d(np.asarray(m[0].weight._value), 2, 4)
+    assert asp.calculate_density(m[0].weight) <= 0.5 + 1e-6
