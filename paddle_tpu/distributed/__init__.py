@@ -18,6 +18,7 @@ from .utils import global_scatter, global_gather
 from .spawn import spawn
 from . import sharding
 from . import auto_parallel
+from . import ps
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op, reshard
 
 

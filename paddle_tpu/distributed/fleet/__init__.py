@@ -134,12 +134,69 @@ def barrier_worker():
 
 def save_persistables(executor=None, dirname=None, main_program=None,
                       mode=0):
+    """PS mode: persist every server shard's tables (reference
+    fleet.save_persistables over the_one_ps)."""
+    if _fleet_state.get("ps_client") is not None and dirname:
+        _fleet_state["ps_client"].save(dirname + "/ps_tables")
     return None
+
+
+# -- parameter-server mode (reference the_one_ps.py TheOnePSRuntime) --------
+
+def is_server():
+    import os
+
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "PSERVER"
+
+
+def is_worker():
+    return not is_server()
+
+
+def init_server(*args, **kwargs):
+    """Start this process's PS shard (endpoint from
+    PADDLE_CURRENT_ENDPOINT, reference env contract)."""
+    import os
+
+    from ..ps import PSServer
+
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+    host, port = ep.rsplit(":", 1)
+    srv = PSServer(host=host, port=int(port),
+                   server_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    _fleet_state["ps_server"] = srv
+    return srv
+
+
+def run_server():
+    """Block serving (reference fleet.run_server)."""
+    srv = _fleet_state.get("ps_server") or init_server()
+    srv._thread.join()
 
 
 def init_worker():
-    return None
+    """Connect this trainer to the PS shards
+    (PADDLE_PSERVER_ENDPOINTS / PADDLE_PSERVERS_IP_PORT_LIST)."""
+    import os
+
+    eps = (os.environ.get("PADDLE_PSERVER_ENDPOINTS")
+           or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"))
+    if eps:
+        from ..ps import PSClient
+
+        _fleet_state["ps_client"] = PSClient(
+            [e.strip() for e in eps.split(",") if e.strip()])
+    return _fleet_state.get("ps_client")
 
 
 def stop_worker():
+    c = _fleet_state.pop("ps_client", None)
+    if c is not None:
+        c.close()
     return None
+
+
+def stop_server():
+    s = _fleet_state.pop("ps_server", None)
+    if s is not None:
+        s.stop()

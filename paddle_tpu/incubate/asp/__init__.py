@@ -136,16 +136,19 @@ class OptimizerWithSparsityGuarantee:
 
     def __init__(self, optimizer):
         self._inner = optimizer
-        param_ids = {id(p) for p in
-                     (getattr(optimizer, "_parameter_list", None) or [])}
-        self._mine = [(r, m) for pid, (r, m) in _masks.items()
-                      if not param_ids or pid in param_ids]
+        # remember WHICH params are ours; consult the live _masks at
+        # step time so the documented decorate-then-prune order works
+        self._param_ids = {
+            id(p) for p in
+            (getattr(optimizer, "_parameter_list", None) or [])}
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
     def _reapply(self):
-        for ref, mask in self._mine:
+        for pid, (ref, mask) in list(_masks.items()):
+            if self._param_ids and pid not in self._param_ids:
+                continue
             p = ref()
             if p is not None:
                 p._value = jnp.asarray(p._value) * jnp.asarray(mask)

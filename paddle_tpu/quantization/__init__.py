@@ -155,7 +155,8 @@ class ImperativeQuantAware:
                     getattr(parent, "_sub_layers", {}).items()):
                 q = self._wrap(child)
                 if q is not None:
-                    parent._sub_layers[name] = q
+                    # Layer.__setattr__ routes Layer values into
+                    # _sub_layers
                     setattr(parent, name, q)
         return model
 
@@ -272,7 +273,6 @@ class PostTrainingQuantization:
                                    getattr(child, "bias", None),
                                    act_scale=self._act_stats.get(
                                        id(child)), bits=self._bits)
-                    parent._sub_layers[name] = q
                     setattr(parent, name, q)
         return self._model
 

@@ -1,0 +1,171 @@
+"""Parameter server: tables, RPC, sharding, async communicator,
+distributed embedding training (reference:
+ps/service/brpc_ps_{client,server}.cc, ps/table/, the_one_ps.py:606)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                       DistributedEmbedding, PSClient,
+                                       PSServer)
+
+
+@pytest.fixture()
+def cluster():
+    servers = [PSServer(server_id=i) for i in range(2)]
+    client = PSClient([s.endpoint for s in servers])
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def test_dense_table_pull_push(cluster):
+    _, c = cluster
+    c.create_dense_table("w", (4, 3), initializer=np.ones((4, 3)))
+    w0 = c.pull_dense("w")
+    np.testing.assert_array_equal(w0, 1.0)
+    c.push_dense("w", np.full((4, 3), 0.5), lr=1.0)
+    np.testing.assert_allclose(c.pull_dense("w"), 0.5)
+
+
+def test_sparse_table_shard_pull_push(cluster):
+    servers, c = cluster
+    c.create_sparse_table("emb", emb_dim=4, initializer="zeros")
+    ids = np.array([0, 1, 2, 3, 10, 11], np.int64)
+    rows = c.pull_sparse("emb", ids)
+    assert rows.shape == (6, 4)
+    np.testing.assert_array_equal(rows, 0.0)
+    # rows landed on both shards (even ids -> server 0, odd -> 1)
+    assert servers[0]._sparse["emb"].size() == 3
+    assert servers[1]._sparse["emb"].size() == 3
+    grads = np.ones((6, 4), np.float32)
+    c.push_sparse("emb", ids, grads, lr=0.5)
+    np.testing.assert_allclose(c.pull_sparse("emb", ids), -0.5)
+
+
+def test_sparse_rows_lazily_initialized_deterministic(cluster):
+    _, c = cluster
+    c.create_sparse_table("e2", emb_dim=8)
+    a = c.pull_sparse("e2", [100])
+    b = c.pull_sparse("e2", [100])
+    np.testing.assert_array_equal(a, b)  # same row on re-pull
+    assert np.abs(a).max() > 0  # uniform init, not zeros
+
+
+def test_save_load_roundtrip(cluster, tmp_path):
+    servers, c = cluster
+    c.create_sparse_table("e3", emb_dim=2, initializer="zeros")
+    c.push_sparse("e3", [1, 2], np.ones((2, 2)), lr=1.0)
+    c.save(str(tmp_path / "ckpt"))
+    c.push_sparse("e3", [1, 2], np.ones((2, 2)), lr=1.0)  # diverge
+    c.load(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(c.pull_sparse("e3", [1, 2]), -1.0)
+
+
+def test_barrier_two_workers(cluster):
+    _, c = cluster
+    c2 = PSClient(c._endpoints)
+    errs = []
+
+    def other():
+        try:
+            c2.barrier("sync1", 2, timeout=5)
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    c.barrier("sync1", 2, timeout=5)
+    t.join(timeout=5)
+    assert not errs
+    c2.close()
+
+
+def test_barrier_key_reusable_across_epochs(cluster):
+    """The same barrier key must synchronize again next epoch
+    (round-2 review: stale counts made later barriers no-ops)."""
+    _, c = cluster
+    c2 = PSClient(c._endpoints)
+    errs = []
+
+    def other(n_epochs):
+        try:
+            for _ in range(n_epochs):
+                c2.barrier("ep", 2, timeout=5)
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=other, args=(2,))
+    t.start()
+    c.barrier("ep", 2, timeout=5)
+    c.barrier("ep", 2, timeout=5)
+    t.join(timeout=10)
+    assert not errs
+    # epoch 3 with only ONE participant must time out (no stale count)
+    with pytest.raises(TimeoutError):
+        c.barrier("ep", 2, timeout=0.5)
+    c2.close()
+
+
+def test_save_load_preserves_table_config(cluster, tmp_path):
+    """Restore into a fresh server must keep optimizer rule + lr."""
+    servers, c = cluster
+    c.create_sparse_table("cfg_t", emb_dim=2, optimizer="adagrad",
+                          lr=0.01, initializer="zeros")
+    c.push_sparse("cfg_t", [4], np.ones((1, 2)))
+    c.save(str(tmp_path / "cfg"))
+    # wipe server-side tables, then load
+    for s in servers:
+        s._sparse.clear()
+    c.load(str(tmp_path / "cfg"))
+    tbl = servers[0]._sparse["cfg_t"]
+    assert tbl.optimizer == "adagrad" and tbl.lr == 0.01
+
+
+def test_distributed_embedding_bounds_check(cluster):
+    _, c = cluster
+    emb = DistributedEmbedding(c, "bounded", num_embeddings=10,
+                               emb_dim=2)
+    with pytest.raises(IndexError, match="out of range"):
+        emb(np.array([3, 99], np.int64))
+
+
+def test_async_communicator_flushes(cluster):
+    _, c = cluster
+    c.create_sparse_table("e4", emb_dim=2, initializer="zeros")
+    comm = AsyncCommunicator(c, flush_interval=0.01)
+    comm.push_sparse_async("e4", [7], np.ones((1, 2)), lr=1.0)
+    comm.stop()  # stop() flushes
+    np.testing.assert_allclose(c.pull_sparse("e4", [7]), -1.0)
+
+
+def test_distributed_embedding_trains(cluster):
+    """CTR-style run: PS-hosted embedding + local dense head; loss
+    decreases and sparse rows update through the backward hook."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    _, c = cluster
+    paddle.seed(0)
+    emb = DistributedEmbedding(c, "ctr_emb", num_embeddings=1000,
+                               emb_dim=8, lr=0.5)
+    head = nn.Linear(8, 1)
+    opt = optim.SGD(learning_rate=0.1, parameters=head.parameters())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1000, (16,)).astype(np.int64)
+    y = (ids % 2).astype(np.float32).reshape(16, 1)
+
+    losses = []
+    for _ in range(30):
+        e = emb(paddle.to_tensor(ids))
+        out = head(e)
+        loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.8
+    assert c.sparse_size("ctr_emb") == len(np.unique(ids))
