@@ -56,6 +56,7 @@ from . import hub
 from . import onnx
 from . import sparse
 from . import quantization
+from . import cost_model
 from . import utils
 from . import linalg as _linalg_ns
 from . import fft

@@ -1,0 +1,116 @@
+"""LoD (level-of-detail) ragged metadata.
+
+Parity target: paddle/fluid/framework/lod_tensor.h — LoDTensor wraps a
+dense tensor with nested sequence offsets so variable-length batches
+ride one buffer.
+
+TPU-native design (SURVEY §7 hard part (b)): XLA wants static shapes,
+so LoD here is METADATA-ONLY over dense padded storage — `to_padded`
+produces the [batch, max_len, ...] tensor + mask every kernel consumes
+(dense+mask semantics), `from_sequences` builds it from a ragged list,
+and `recursive_sequence_lengths`/`lod` round-trip the reference's
+offset representation exactly."""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["LoDTensor", "create_lod_tensor"]
+
+
+class LoDTensor:
+    """Dense values + LoD offsets (reference lod_tensor.h semantics:
+    lod = [[0, 2, 5]] means sequence 0 = rows [0:2), seq 1 = [2:5))."""
+
+    def __init__(self, value, lod=None):
+        self._tensor = (value if isinstance(value, Tensor)
+                        else Tensor(np.asarray(value)))
+        self._lod = [list(map(int, lv)) for lv in (lod or [])]
+        self._check()
+
+    def _check(self):
+        n = self._tensor.shape[0] if self._tensor.shape else 0
+        for i, level in enumerate(self._lod):
+            if level and (level[0] != 0 or sorted(level) != level):
+                raise ValueError(f"invalid LoD level {i}: {level}")
+        if self._lod and self._lod[-1] and self._lod[-1][-1] != n:
+            raise ValueError(
+                f"last LoD offset {self._lod[-1][-1]} != rows {n}")
+
+    # -- reference API -----------------------------------------------------
+    def lod(self):
+        return [list(lv) for lv in self._lod]
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, lv)) for lv in lod]
+        self._check()
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(lv, lv[1:])] for lv in self._lod]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        lod = []
+        for lens in lengths:
+            offs = [0]
+            for n in lens:
+                offs.append(offs[-1] + int(n))
+            lod.append(offs)
+        self._lod = lod
+        self._check()
+
+    def has_valid_recursive_sequence_lengths(self):
+        try:
+            self._check()
+            return True
+        except ValueError:
+            return False
+
+    def tensor(self):
+        return self._tensor
+
+    def numpy(self):
+        return np.asarray(self._tensor._value)
+
+    @property
+    def shape(self):
+        return self._tensor.shape
+
+    def num_sequences(self, level=-1):
+        return len(self._lod[level]) - 1 if self._lod else 1
+
+    # -- dense+mask bridge (the TPU compute representation) ---------------
+    def to_padded(self, pad_value=0.0, level=-1):
+        """[total_rows, ...] -> ([num_seq, max_len, ...], mask)."""
+        vals = self.numpy()
+        offs = self._lod[level]
+        lens = [b - a for a, b in zip(offs, offs[1:])]
+        max_len = max(lens) if lens else 0
+        out = np.full((len(lens), max_len) + vals.shape[1:], pad_value,
+                      vals.dtype)
+        mask = np.zeros((len(lens), max_len), bool)
+        for i, (a, b) in enumerate(zip(offs, offs[1:])):
+            out[i, : b - a] = vals[a:b]
+            mask[i, : b - a] = True
+        return Tensor(out), Tensor(mask)
+
+    @staticmethod
+    def from_sequences(seqs):
+        """Ragged list of [len_i, ...] arrays -> packed LoDTensor."""
+        seqs = [np.asarray(s) for s in seqs]
+        offs = [0]
+        for s in seqs:
+            offs.append(offs[-1] + (s.shape[0] if s.ndim else 1))
+        packed = (np.concatenate(seqs, axis=0) if seqs
+                  else np.zeros((0,), np.float32))
+        return LoDTensor(packed, lod=[offs])
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape}, lod={self._lod})")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference fluid/lod_tensor.py create_lod_tensor."""
+    t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t

@@ -66,3 +66,5 @@ def current_stream(device=None):
 
 def set_stream(stream):
     return stream
+
+from . import plugin  # CustomDevice/PJRT plugin registry

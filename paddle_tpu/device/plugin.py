@@ -1,0 +1,51 @@
+"""Custom device plugin registry.
+
+Parity target: the reference's CustomDevice C-ABI
+(paddle/fluid/platform/device/device_ext.h:46 `C_DeviceInterface` — a
+versioned struct of function pointers third-party hardware fills in,
+registered through device_manager.cc).
+
+TPU-native design: the hardware-plugin ABI of the JAX stack IS PJRT —
+a vendor ships a PJRT C-API plugin (.so) and the framework loads it.
+`register_custom_device` wraps jax's plugin registration
+(jax.plugins/xla_bridge.register_plugin), which is the exact
+`C_DeviceInterface` analog: init/discovery/stream/memory hooks live
+behind the PJRT C API instead of a Paddle-private struct."""
+from __future__ import annotations
+
+__all__ = ["register_custom_device", "list_custom_devices",
+           "is_custom_device_available"]
+
+_registered = {}
+
+
+def register_custom_device(name, library_path=None, options=None,
+                           priority=400):
+    """Register a PJRT plugin as a named custom device backend.
+
+    name: backend name ('my_npu'); library_path: the PJRT C-API .so
+    (the vendor's C_DeviceInterface equivalent). Must run before the
+    first jax backend touch (same constraint as the reference:
+    plugins load at InitDevices)."""
+    from jax._src import xla_bridge
+
+    if name in _registered:
+        raise ValueError(f"custom device {name!r} already registered")
+    xla_bridge.register_plugin(name, library_path=library_path,
+                               options=options, priority=priority)
+    _registered[name] = {"library_path": library_path,
+                         "options": dict(options or {})}
+    return name
+
+
+def list_custom_devices():
+    return sorted(_registered)
+
+
+def is_custom_device_available(name):
+    import jax
+
+    try:
+        return len(jax.devices(name)) > 0
+    except RuntimeError:
+        return False

@@ -226,10 +226,18 @@ class PSServer:
             self.create_sparse_table(req["table"], req["emb_dim"],
                                      **req.get("kw", {}))
             return {"ok": True}
+        if op == "sparse_dim":
+            return {"ok": True,
+                    "value": self._sparse[req["table"]].emb_dim}
         if op == "sparse_size":
             return {"ok": True,
                     "value": self._sparse[req["table"]].size()}
         if op == "save":
+            import os as _os
+
+            d = _os.path.dirname(req["path"])
+            if d:
+                _os.makedirs(d, exist_ok=True)
             state = {"dense": {k: {"value": t.pull(), "lr": t.lr}
                                for k, t in self._dense.items()},
                      "sparse": {k: t.state()
@@ -242,8 +250,10 @@ class PSServer:
                 state = pickle.load(f)
             for k, v in state["dense"].items():
                 val, lr = v["value"], v["lr"]
-                self._dense.setdefault(
-                    k, DenseTable(np.shape(val), lr=lr)).set(val)
+                tbl = self._dense.setdefault(
+                    k, DenseTable(np.shape(val), lr=lr))
+                tbl.set(val)
+                tbl.lr = lr  # existing table: restore hyperparams too
             for k, st in state["sparse"].items():
                 tbl = self._sparse.get(k)
                 if tbl is None:
@@ -255,25 +265,24 @@ class PSServer:
                 tbl.load_state(st)
             return {"ok": True}
         if op == "barrier_enter":
+            # ticket barrier, ALL state server-side (restart-safe):
+            # enter returns a ticket; tickets release in blocks of
+            # `world` as arrivals accumulate
             with self._barrier_lock:
                 key = req["key"]
-                self._barrier_count[key] = \
-                    self._barrier_count.get(key, 0) + 1
-            return {"ok": True}
+                st = self._barrier_count.setdefault(
+                    key, {"entered": 0, "released": 0})
+                st["entered"] += 1
+                ticket = st["entered"]
+                while st["entered"] - st["released"] >= req["world"]:
+                    st["released"] += req["world"]
+            return {"ok": True, "value": ticket}
         if op == "barrier_poll":
             with self._barrier_lock:
-                done = (self._barrier_count.get(req["key"], 0)
-                        >= req["world"])
+                st = self._barrier_count.get(
+                    req["key"], {"entered": 0, "released": 0})
+                done = req["ticket"] <= st["released"]
             return {"ok": True, "value": done}
-        if op == "barrier_exit":
-            with self._barrier_lock:
-                key = req["key"]
-                self._barrier_count[key + "#exit"] = \
-                    self._barrier_count.get(key + "#exit", 0) + 1
-                if self._barrier_count[key + "#exit"] >= req["world"]:
-                    self._barrier_count.pop(key, None)
-                    self._barrier_count.pop(key + "#exit", None)
-            return {"ok": True}
         raise ValueError(f"unknown PS op {op}")
 
     def stop(self):
@@ -289,7 +298,7 @@ class PSClient:
         self._endpoints = list(endpoints)
         self._conns = [None] * len(self._endpoints)
         self._locks = [threading.Lock() for _ in self._endpoints]
-        self._barrier_gen = {}
+        self._sparse_dims = {}
 
     def _call(self, server, req):
         with self._locks[server]:
@@ -323,6 +332,7 @@ class PSClient:
                        "lr": lr})
 
     def create_sparse_table(self, table, emb_dim, **kw):
+        self._sparse_dims[table] = emb_dim
         for s in range(self.num_servers):
             self._call(s, {"op": "create_sparse", "table": table,
                            "emb_dim": emb_dim, "kw": kw})
@@ -346,7 +356,11 @@ class PSClient:
     def pull_sparse(self, table, ids):
         ids, srv = self._shard(ids)
         if len(ids) == 0:
-            return np.empty((0, 0), np.float32)
+            dim = self._sparse_dims.get(table)
+            if dim is None:
+                dim = self._call(0, {"op": "sparse_dim", "table": table})
+                self._sparse_dims[table] = dim
+            return np.empty((0, dim), np.float32)
         rows = [None] * len(ids)
         for s in range(self.num_servers):
             idx = np.nonzero(srv == s)[0]
@@ -382,21 +396,19 @@ class PSClient:
             self._call(s, {"op": "load", "path": f"{path}.shard{s}"})
 
     def barrier(self, key, world, timeout=30.0):
-        """Enter once, poll until `world` workers arrived, then exit
-        (reference barrier table semantics). Keys are generation-scoped
-        client-side so the same key is reusable every epoch."""
+        """Ticket barrier (reference barrier table semantics): enter
+        returns a server-assigned ticket; the barrier passes when the
+        server has released the caller's block of `world` arrivals.
+        All state is server-side, so the same key is reusable across
+        epochs and a relaunched worker just takes the next ticket."""
         import time
 
-        gen = self._barrier_gen.get(key, 0)
-        self._barrier_gen[key] = gen + 1
-        gkey = f"{key}#{gen}"
         deadline = time.time() + timeout
-        self._call(0, {"op": "barrier_enter", "key": gkey})
+        ticket = self._call(0, {"op": "barrier_enter", "key": key,
+                                "world": world})
         while time.time() < deadline:
-            if self._call(0, {"op": "barrier_poll", "key": gkey,
-                              "world": world}):
-                self._call(0, {"op": "barrier_exit", "key": gkey,
-                               "world": world})
+            if self._call(0, {"op": "barrier_poll", "key": key,
+                              "ticket": ticket}):
                 return
             time.sleep(0.05)
         raise TimeoutError(f"PS barrier {key} timed out")

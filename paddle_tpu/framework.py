@@ -82,3 +82,5 @@ def in_dygraph_mode():
 
 
 _dygraph_tracer = lambda: None
+
+from .core.lod import LoDTensor, create_lod_tensor  # noqa: E402

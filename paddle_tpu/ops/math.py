@@ -63,6 +63,7 @@ _UNARY = {
     "log10": jnp.log10,
     "log1p": jnp.log1p,
     "log2": jnp.log2,
+    "logit": jax.scipy.special.logit,
     "neg": jnp.negative,
     "reciprocal": jnp.reciprocal,
     "round": jnp.round,

@@ -5,66 +5,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..io import Dataset
+from . import datasets
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
 
 __all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "Movielens",
-           "Conll05st", "ViterbiDecoder", "viterbi_decode"]
+           "Conll05st", "ViterbiDecoder", "viterbi_decode", "datasets"]
 
 
-class UCIHousing(Dataset):
-    def __init__(self, data_file=None, mode="train", download=True):
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = 404 if mode == "train" else 102
-        self.x = rng.randn(n, 13).astype(np.float32)
-        w = rng.randn(13).astype(np.float32)
-        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)
-
-    def __getitem__(self, idx):
-        return self.x[idx], np.asarray([self.y[idx]], np.float32)
-
-    def __len__(self):
-        return len(self.x)
-
-
-class _SyntheticSeqDataset(Dataset):
-    VOCAB = 1000
-    LEN = 32
-    N = 512
-
-    def __init__(self, data_file=None, mode="train", download=True, **kw):
-        rng = np.random.RandomState(3 if mode == "train" else 5)
-        self.seqs = rng.randint(1, self.VOCAB, (self.N, self.LEN)).astype(
-            np.int64)
-        self.labels = rng.randint(0, 2, self.N).astype(np.int64)
-
-    def __getitem__(self, idx):
-        return self.seqs[idx], self.labels[idx]
-
-    def __len__(self):
-        return self.N
-
-
-class Imdb(_SyntheticSeqDataset):
-    pass
-
-
-class Imikolov(_SyntheticSeqDataset):
-    pass
-
-
-class WMT14(_SyntheticSeqDataset):
-    pass
-
-
-class WMT16(_SyntheticSeqDataset):
-    pass
-
-
-class Movielens(_SyntheticSeqDataset):
-    pass
-
-
-class Conll05st(_SyntheticSeqDataset):
-    pass
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

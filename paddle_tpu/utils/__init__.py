@@ -3,8 +3,9 @@ toolchain, download helpers, deprecations)."""
 from __future__ import annotations
 
 from . import cpp_extension
+from . import custom_op
 
-__all__ = ["cpp_extension", "try_import", "run_check", "deprecated"]
+__all__ = ["cpp_extension", "custom_op", "try_import", "run_check", "deprecated"]
 
 
 def try_import(module_name, err_msg=None):

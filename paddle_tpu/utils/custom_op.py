@@ -1,0 +1,142 @@
+"""Custom op registration — the PD_REGISTER_KERNEL / custom-operator
+plug point.
+
+Parity target: paddle/phi/core/kernel_registry.h:993
+(PD_REGISTER_KERNEL), phi/core/custom_kernel.cc (third-party kernel
+registration), and utils/cpp_extension custom C++ operators.
+
+TPU-native design: an op is a pure jax function (optionally with a
+custom VJP) registered into a process-wide registry and exposed as a
+callable that dispatches through `apply_op` — so custom ops get the
+tape, AMP hooks, static-graph recording, and jit compilation exactly
+like built-ins. C kernels from cpp_extension shared libraries plug in
+through `jax.pure_callback` (host callback; runs on CPU alongside the
+XLA program — the CustomDevice-kernel analog for host-side ops)."""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+
+__all__ = ["register_op", "register_c_op", "get_op", "list_ops",
+           "CustomOpRegistry"]
+
+
+class CustomOpRegistry:
+    def __init__(self):
+        self._ops = {}
+
+    def register(self, name, fn):
+        if name in self._ops:
+            raise ValueError(f"custom op {name!r} already registered")
+        self._ops[name] = fn
+        return fn
+
+    def get(self, name):
+        if name not in self._ops:
+            raise KeyError(
+                f"custom op {name!r} is not registered "
+                f"(known: {sorted(self._ops)})")
+        return self._ops[name]
+
+    def names(self):
+        return sorted(self._ops)
+
+
+registry = CustomOpRegistry()
+
+
+def register_op(name, fn=None, vjp=None):
+    """Register a pure-jax custom op (PD_REGISTER_KERNEL analog).
+
+    fn(*arrays, **attrs) -> array/pytree. Optional custom vjp:
+    vjp(residuals, cotangents) with fn returning (out, residuals) —
+    wired via jax.custom_vjp so autograd uses it.
+
+    Usable as a decorator: @register_op("my_op").
+    """
+    def do_register(f):
+        if vjp is None:
+            def op(*args, **attrs):
+                return apply_op(name, f, *args, **attrs)
+        else:
+            # jax.custom_vjp rejects keyword args — bind the attrs
+            # into a per-attrs wrapped kernel (cached by frozen attrs)
+            cache = {}
+
+            def kernel_for(attrs):
+                key = tuple(sorted(attrs.items()))
+                w = cache.get(key)
+                if w is None:
+                    w = jax.custom_vjp(
+                        lambda *a: f(*a, **dict(key))[0])
+                    w.defvjp(lambda *a: f(*a, **dict(key)),
+                             lambda res, cot: vjp(res, cot))
+                    cache[key] = w
+                return w
+
+            def op(*args, **attrs):
+                return apply_op(name, kernel_for(attrs), *args)
+
+        op.__name__ = name
+        registry.register(name, op)
+        return op
+
+    return do_register if fn is None else do_register(fn)
+
+
+def register_c_op(name, c_fn, out_shape_fn, out_dtype=np.float32,
+                  arg_types=None):
+    """Register a C kernel from a cpp_extension library as an op.
+
+    c_fn: ctypes function with signature
+        (const float* in0, int64 n0, ..., float* out, int64 n_out)
+        — one (ptr, len) pair per input, then the output buffer.
+    out_shape_fn(*input_shapes) -> output shape.
+
+    The kernel runs through jax.pure_callback: XLA calls back onto the
+    host thread (the reference's CPU-kernel dispatch path); under jit
+    the callback is scheduled inside the compiled program.
+    """
+    def host_impl(*arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        out_shape = out_shape_fn(*[a.shape for a in arrays])
+        out = np.zeros(out_shape, out_dtype)
+        argv = []
+        for a in arrays:
+            argv.append(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            argv.append(ctypes.c_int64(a.size))
+        argv.append(out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        argv.append(ctypes.c_int64(out.size))
+        c_fn(*argv)
+        return out
+
+    def kernel(*arrays):
+        out_shape = out_shape_fn(*[a.shape for a in arrays])
+        return jax.pure_callback(
+            host_impl,
+            jax.ShapeDtypeStruct(tuple(out_shape), out_dtype),
+            *arrays)
+
+    def op(*args, **attrs):
+        if attrs:
+            raise TypeError(
+                f"C op {name!r} takes no attribute kwargs (the C ABI "
+                f"carries only tensor buffers); got {sorted(attrs)}")
+        return apply_op(name, kernel, *args)
+
+    op.__name__ = name
+    registry.register(name, op)
+    return op
+
+
+def get_op(name):
+    return registry.get(name)
+
+
+def list_ops():
+    return registry.names()

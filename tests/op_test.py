@@ -60,6 +60,44 @@ class OpTest(unittest.TestCase):
                     for o in out]
         return [np.asarray(out._value if isinstance(out, Tensor) else out)]
 
+    # threshold policy (reference op_accuracy_white_list /
+    # op_threshold_white_list machinery): per-dtype tolerances
+    DTYPE_THRESHOLDS = {
+        "float32": (1e-5, 1e-6),
+        "bfloat16": (2e-2, 2e-2),
+        "float16": (1e-3, 1e-3),
+    }
+
+    def check_output_with_dtypes(self, dtypes=("float32", "bfloat16")):
+        """Dtype sweep (reference: each op registers kernels per dtype
+        and OpTest validates each): cast float inputs, compare against
+        the float64 expectation at the dtype's threshold."""
+        base_inputs = {k: np.asarray(v) for k, v in self.inputs.items()}
+        expected = self.outputs
+        if not isinstance(expected, (list, tuple)):
+            expected = [expected]
+        for dt in dtypes:
+            rtol, atol = self.DTYPE_THRESHOLDS[dt]
+            import jax.numpy as jnp
+
+            jdt = {"float32": np.float32, "float16": np.float16,
+                   "bfloat16": jnp.bfloat16}[dt]
+            ts = []
+            for v in base_inputs.values():
+                if v.dtype.kind == "f":
+                    ts.append(paddle.to_tensor(
+                        jnp.asarray(v).astype(jdt)))
+                else:
+                    ts.append(paddle.to_tensor(v))
+            out = type(self).op(*ts, **self.attrs)
+            got = self._norm_out(out)
+            for g, e in zip(got, expected):
+                g64 = np.asarray(g).astype(np.float64)
+                e64 = np.asarray(e, np.float64)
+                np.testing.assert_allclose(
+                    g64, e64, rtol=rtol, atol=atol,
+                    err_msg=f"{self.op} mismatch at dtype {dt}")
+
     def check_output(self, check_jit=True):
         expected = self.outputs
         if not isinstance(expected, (list, tuple)):
