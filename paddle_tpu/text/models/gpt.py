@@ -57,6 +57,9 @@ class GPTConfig:
     # pp_microbatches micro-batches (0 = plain scan-over-layers)
     pp_num_stages: int = 0
     pp_microbatches: int = 0
+    # "gpipe" holds all M micro-batch activations; "1f1b" remats each
+    # tick so live activations are O(S) — the 1F1B memory bound
+    pp_schedule: str = "gpipe"
 
 
 def _maybe_constrain(x, spec):
@@ -147,7 +150,7 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout, use_ring=False):
 
 def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
                    dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
-                   use_ring=False):
+                   use_ring=False, pp_schedule="gpipe"):
     x = jnp.take(params["wte"], ids, axis=0)
     pos = jnp.arange(ids.shape[1])
     x = x + jnp.take(params["wpe"], pos, axis=0)
@@ -192,7 +195,8 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
             return out
 
         xm = microbatch(x, pp_microbatches)
-        ym = gpipe_loop(stage_fn, stage_blocks, xm, pp_stages)
+        ym = gpipe_loop(stage_fn, stage_blocks, xm, pp_stages,
+                        schedule=pp_schedule)
         x = unmicrobatch(ym)
     elif layer_keys is not None:
         x, _ = jax.lax.scan(scan_body, x, (blocks, layer_keys))
@@ -207,12 +211,12 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
 
 def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
                 dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
-                use_ring=False):
+                use_ring=False, pp_schedule="gpipe"):
     """Causal-LM loss with the standard next-token shift: position t
     predicts labels[t+1] (HF convention — pass labels=input_ids)."""
     logits = _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
                             dropout, key, pp_stages, pp_microbatches,
-                            use_ring)
+                            use_ring, pp_schedule)
     lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = labels[:, 1:]
     picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
@@ -291,7 +295,8 @@ class GPTModel(Layer):
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
-                        use_ring=c.use_ring_attention)
+                        use_ring=c.use_ring_attention,
+                        pp_schedule=c.pp_schedule)
 
 
 class GPTForCausalLM(Layer):
@@ -312,7 +317,8 @@ class GPTForCausalLM(Layer):
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
-                        use_ring=c.use_ring_attention)
+                        use_ring=c.use_ring_attention,
+                        pp_schedule=c.pp_schedule)
 
 
 def gpt2_small(**kw):

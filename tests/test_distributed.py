@@ -319,3 +319,33 @@ def test_pipeline_parallel_ernie_pp2_parity():
     base, pipe = run(1), run(2)
     assert max(abs(a - b) for a, b in zip(base, pipe)) < 5e-4, (
         f"{base} vs {pipe}")
+
+
+def test_1f1b_schedule_parity_with_gpipe():
+    """pp_schedule='1f1b' (remat-per-tick: the 1F1B activation-memory
+    bound) must reproduce the gpipe losses exactly."""
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+    import paddle_tpu.optimizer as optim
+
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        paddle.seed(5)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=2, ffn_hidden=64, max_seq_len=16,
+                        dropout=0.0, use_flash_attention=False,
+                        remat=False, pp_num_stages=4, pp_microbatches=4,
+                        pp_schedule=sched)
+        model = GPTForCausalLM(cfg)
+        opt = optim.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        set_mesh(mesh)
+        step = DistributedTrainStepCompiler(model, opt, mesh=mesh)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (8, 16)).astype(np.int32)
+        losses[sched] = [float(step(ids, ids).item()) for _ in range(3)]
+        set_mesh(None)
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"],
+                               rtol=1e-5, atol=1e-6)
+    assert losses["1f1b"][-1] < losses["1f1b"][0]
