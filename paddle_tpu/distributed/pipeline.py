@@ -80,18 +80,14 @@ def gpipe_loop(stage_fn, stage_params, mb_inputs, num_stages,
         (after the stage dim), e.g. ("dp", "sp") for [mb, seq, hidden].
     schedule: "gpipe" | "1f1b".
 
-    On the 1F1B question (reference dygraph 1F1B,
-    pipeline_parallel.py:80-150): under XLA whole-program compilation
-    the COMPUTE schedule is the compiler's — forward and backward are
-    one fused program and the steady-state bubble of this loop already
-    equals 1F1B's (M/(M+S-1) utilization either way). What 1F1B buys
-    on a per-rank runtime is ACTIVATION MEMORY: at most S in-flight
-    micro-batches instead of M. schedule="1f1b" achieves exactly that
-    bound here by remat-ing each tick (jax.checkpoint): the backward
-    scan recomputes a tick's stage activations when it needs them, so
-    live activations are O(S · state) regardless of M — the 1F1B
-    memory property, derived by the compiler instead of a hand-written
-    interleave that would fight XLA's scheduler.
+    Schedules: the steady-state bubble of this loop equals 1F1B's
+    (M/(M+S-1) utilization either way — under XLA the compute schedule
+    is the compiler's). The difference is ACTIVATION MEMORY:
+    - "gpipe": jax autodiff through the loop — the scan saves one
+      pipeline state per tick, O(M · S-state);
+    - "1f1b": exact 1F1B via _one_f_one_b — a custom-vjp whose
+      backward interleaves forward recompute and backward per tick
+      with a ring stash, live memory independent of M.
 
     Returns [M, mb, ...] stacked last-stage outputs.
     """
@@ -117,9 +113,126 @@ def gpipe_loop(stage_fn, stage_params, mb_inputs, num_stages,
         return shifted, out_last
 
     if schedule == "1f1b":
-        tick = jax.checkpoint(tick)
-    elif schedule != "gpipe":
+        return _one_f_one_b(stage_fn, stage_params, mb_inputs, S,
+                            state_spec)
+    if schedule != "gpipe":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
     _, outs = jax.lax.scan(tick, state, jnp.arange(num_micro + S - 1))
     return outs[S - 1:]
+
+
+def _one_f_one_b(stage_fn, stage_params, mb_inputs, S,
+                 state_spec=("dp", "sp")):
+    """Exact 1F1B (reference forward_backward_pipeline,
+    pipeline_parallel.py:80-150), SPMD-vectorized.
+
+    The pipeline segment is a jax.custom_vjp:
+    - forward: the plain pipelined loop, NO residuals beyond the
+      outputs — live activation memory is one [S, mb, ...] state;
+    - backward: ONE combined scan where every tick runs, per stage and
+      in parallel across stages, the forward of one micro-batch AND
+      the vjp of an earlier one (the 1F1B steady state). Stage s
+      backwards micro-batch i at tick i + 2S-1 - s, consuming the
+      stage input stashed 2S-1-2s ticks earlier from a ring buffer of
+      depth 2S. Live memory in the backward program is the stash —
+      O(S · 2S · mb-state), INDEPENDENT of the number of micro-batches
+      — which is the 1F1B in-flight bound (the reference holds ≤S
+      activations per stage; the ring is the vectorized equivalent).
+      Cotangents shift stage s -> s-1 each tick (the reverse
+      collective-permute pipeline), and per-tick validity masks zero
+      the warmup/cooldown garbage out of the parameter grads.
+    """
+    M = mb_inputs.shape[0]
+    D = 2 * S  # stash ring depth
+    vstage = jax.vmap(stage_fn)
+    mb_shape = mb_inputs.shape[1:]
+    dtype = mb_inputs.dtype
+
+    def forward(params, mbs):
+        def fwd_tick(state, t):
+            y = vstage(params, state)
+            y = _constrain_state(y, state_spec)
+            nxt = jnp.minimum(t + 1, M - 1)
+            inp = jax.lax.dynamic_index_in_dim(mbs, nxt, axis=0,
+                                               keepdims=False)
+            shifted = jnp.concatenate([inp[None], y[:S - 1]], axis=0)
+            return _constrain_state(shifted, state_spec), y[S - 1]
+
+        state = jnp.zeros((S,) + mb_shape, dtype)
+        state = jax.lax.dynamic_update_index_in_dim(state, mbs[0], 0,
+                                                    axis=0)
+        state = _constrain_state(state, state_spec)
+        _, outs = jax.lax.scan(fwd_tick, state,
+                               jnp.arange(M + S - 1))
+        return outs[S - 1:]
+
+    @jax.custom_vjp
+    def pipeline(params, mbs):
+        return forward(params, mbs)
+
+    def pipeline_fwd(params, mbs):
+        return forward(params, mbs), (params, mbs)
+
+    def pipeline_bwd(res, out_cots):
+        params, mbs = res
+        stage_ids = jnp.arange(S)
+        # stage s backwards mb i at tick i + 2S-1 - s; its input was
+        # stashed at fwd tick i + s, i.e. 2S-1-2s ticks earlier
+        lag = 2 * S - 1 - 2 * stage_ids                       # [S]
+
+        def tick(carry, t):
+            fwd_state, cot_state, stash, gacc = carry
+            # ---- forward half: advance one micro-batch ----
+            y = vstage(params, fwd_state)
+            y = _constrain_state(y, state_spec)
+            # stash THIS tick's stage inputs at ring slot t % D
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, fwd_state, t % D, axis=0)
+            nxt = jnp.clip(t + 1, 0, M - 1)
+            inp = jax.lax.dynamic_index_in_dim(mbs, nxt, axis=0,
+                                               keepdims=False)
+            fwd_state = jnp.concatenate([inp[None], y[:S - 1]], axis=0)
+            fwd_state = _constrain_state(fwd_state, state_spec)
+
+            # ---- backward half ----
+            # inject the out-cot for mb (t - S) into stage S-1's slot
+            ci = jnp.clip(t - S, 0, M - 1)
+            inj = jax.lax.dynamic_index_in_dim(out_cots, ci, axis=0,
+                                               keepdims=False)
+            inj_valid = ((t - S >= 0) & (t - S < M)).astype(dtype)
+            cot_state = cot_state.at[S - 1].set(inj * inj_valid)
+            # validity: stage s is backwarding mb i = t - (2S-1) + s
+            i_of_s = t - (2 * S - 1) + stage_ids
+            valid = ((i_of_s >= 0) & (i_of_s < M)).astype(dtype)
+            cot_masked = cot_state * valid.reshape(
+                (S,) + (1,) * len(mb_shape))
+            # stashed inputs for each stage's in-flight micro-batch
+            slots = (t - lag) % D                              # [S]
+            bwd_x = jax.vmap(
+                lambda sl, s_: stash[sl, s_])(slots, stage_ids)
+            _, vjp_fn = jax.vjp(lambda p, xx: vstage(p, xx), params,
+                                bwd_x)
+            gp, gx = vjp_fn(cot_masked)
+            gacc = jax.tree_util.tree_map(lambda a, b: a + b, gacc, gp)
+            # input cot of stage s becomes stage s-1's output cot
+            out0_cot = gx[0]                   # exits toward upstream
+            cot_state = jnp.concatenate(
+                [gx[1:], jnp.zeros((1,) + mb_shape, dtype)], axis=0)
+            return (fwd_state, cot_state, stash, gacc), out0_cot
+
+        fwd0 = jnp.zeros((S,) + mb_shape, dtype)
+        fwd0 = jax.lax.dynamic_update_index_in_dim(fwd0, mbs[0], 0,
+                                                   axis=0)
+        cot0 = jnp.zeros((S,) + mb_shape, dtype)
+        stash0 = jnp.zeros((D, S) + mb_shape, dtype)
+        gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        T = M + 2 * S - 1
+        (_, _, _, gparams), out0_cots = jax.lax.scan(
+            tick, (fwd0, cot0, stash0, gacc0), jnp.arange(T))
+        # stage 0's input cot for mb i exits at tick i + 2S-1
+        in_cots = out0_cots[2 * S - 1:]
+        return gparams, in_cots
+
+    pipeline.defvjp(pipeline_fwd, pipeline_bwd)
+    return pipeline(stage_params, mb_inputs)

@@ -57,8 +57,11 @@ class GPTConfig:
     # pp_microbatches micro-batches (0 = plain scan-over-layers)
     pp_num_stages: int = 0
     pp_microbatches: int = 0
-    # "gpipe" holds all M micro-batch activations; "1f1b" remats each
-    # tick so live activations are O(S) — the 1F1B memory bound
+    # "gpipe": autodiff through the pipelined loop (activation memory
+    # grows with micro-batch count M). "1f1b": exact 1F1B — a
+    # custom-vjp backward interleaves each micro-batch's forward
+    # recompute with backward, so live activations are O(S^2),
+    # independent of M (reference forward_backward_pipeline).
     pp_schedule: str = "gpipe"
 
 
