@@ -52,6 +52,11 @@ class GPTConfig:
     # chip). Engages only when the live mesh has sp > 1.
     use_ring_attention: bool = False
     remat: bool = True  # jax.checkpoint each block (recompute analog)
+    # selective remat: None = save nothing (full recompute);
+    # "dots" = save matmul/einsum outputs, recompute elementwise only
+    # (jax.checkpoint_policies.dots_saveable) — less recompute FLOPs
+    # for a modest activation-memory increase
+    remat_policy: str | None = None
     # explicit GPipe schedule over the 'pp' mesh axis: num_layers is
     # cut into pp_num_stages stages and the batch into
     # pp_microbatches micro-batches (0 = plain scan-over-layers)
@@ -153,7 +158,8 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout, use_ring=False):
 
 def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
                    dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
-                   use_ring=False, pp_schedule="gpipe"):
+                   use_ring=False, pp_schedule="gpipe",
+                   remat_policy=None):
     x = jnp.take(params["wte"], ids, axis=0)
     pos = jnp.arange(ids.shape[1])
     x = x + jnp.take(params["wpe"], pos, axis=0)
@@ -167,11 +173,16 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
 
     def scan_body(carry, xs):
         layer_params, lkey = xs
-        fn = _block
         if remat:
+            if remat_policy not in (None, "dots"):
+                raise ValueError(
+                    f"remat_policy must be None or 'dots', got "
+                    f"{remat_policy!r}")
+            pol = (jax.checkpoint_policies.dots_saveable
+                   if remat_policy == "dots" else None)
             fn = jax.checkpoint(
                 lambda c, lp, lk: _block(c, lp, lk, n_head, eps, use_flash,
-                                         dropout, use_ring))
+                                         dropout, use_ring), policy=pol)
             out = fn(carry, layer_params, lkey)
         else:
             out = _block(carry, layer_params, lkey, n_head, eps, use_flash,
@@ -214,12 +225,12 @@ def _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
 
 def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
                 dropout=0.0, key=None, pp_stages=0, pp_microbatches=0,
-                use_ring=False, pp_schedule="gpipe"):
+                use_ring=False, pp_schedule="gpipe", remat_policy=None):
     """Causal-LM loss with the standard next-token shift: position t
     predicts labels[t+1] (HF convention — pass labels=input_ids)."""
     logits = _k_gpt_forward(ids, params, n_head, eps, use_flash, remat,
                             dropout, key, pp_stages, pp_microbatches,
-                            use_ring, pp_schedule)
+                            use_ring, pp_schedule, remat_policy)
     lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     tgt = labels[:, 1:]
     picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
@@ -299,7 +310,8 @@ class GPTModel(Layer):
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
                         use_ring=c.use_ring_attention,
-                        pp_schedule=c.pp_schedule)
+                        pp_schedule=c.pp_schedule,
+                        remat_policy=c.remat_policy)
 
 
 class GPTForCausalLM(Layer):
@@ -321,7 +333,8 @@ class GPTForCausalLM(Layer):
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
                         use_ring=c.use_ring_attention,
-                        pp_schedule=c.pp_schedule)
+                        pp_schedule=c.pp_schedule,
+                        remat_policy=c.remat_policy)
 
 
 def gpt2_small(**kw):
