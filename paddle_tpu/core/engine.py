@@ -207,6 +207,11 @@ def set_static_record_hook(fn):
     _static_record_hook = fn
 
 
+# FLAGS_profile_ops re-entrancy guard (the profiled call recurses into
+# apply_op once)
+_profile_guard = threading.local()
+
+
 def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(e) for e in v)
@@ -250,6 +255,23 @@ def apply_op(name, fn, *args, **kwargs):
         rec = _static_record_hook(name, fn, args, kwargs)
         if rec is not NotImplemented:
             return rec
+
+    if flags.get_flag("profile_ops") and not getattr(
+            _profile_guard, "active", False):
+        import time as _time
+
+        from . import monitor as _monitor
+
+        _profile_guard.active = True
+        t0 = _time.perf_counter()
+        try:
+            return apply_op(name, fn, *args, **kwargs)
+        finally:
+            _profile_guard.active = False
+            _monitor.stat_add(f"op/{name}/calls", 1)
+            _monitor.stat_add(
+                f"op/{name}/host_us",
+                int((_time.perf_counter() - t0) * 1e6))
 
     flat_in, in_treedef = tree_util.tree_flatten(
         args, is_leaf=lambda x: x is None or _is_tensor(x)

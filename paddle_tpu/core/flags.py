@@ -42,6 +42,9 @@ _FLAGS = {
     # logging
     "v": _env("v", 0, int),  # VLOG level
     "print_ir": _env("print_ir", False, bool),
+    # profiling: per-op call counts + host dispatch time into the
+    # monitor registry (ir/cost_model op-level stats analog)
+    "profile_ops": _env("profile_ops", False, bool),
 }
 
 

@@ -27,13 +27,15 @@ from ..jit import InputSpec
 from .graph import (Block, OpRecord, Program, StaticRecorder, Variable,
                     cond, while_loop, replay_block)
 from . import nn  # noqa: F401  (paddle.static.nn namespace)
+from . import passes  # noqa: F401  (ir pass registry)
 
 __all__ = [
     "Program", "Variable", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "CompiledProgram",
     "BuildStrategy", "ExecutionStrategy", "InputSpec", "name_scope",
     "save_inference_model", "load_inference_model", "gradients",
-    "append_backward", "cond", "while_loop", "nn",
+    "append_backward", "cond", "while_loop", "nn", "Scope",
+    "global_scope", "scope_guard", "passes",
 ]
 
 _state = threading.local()
@@ -225,7 +227,8 @@ class Executor:
 
         feed_names = tuple(sorted(feed))
         shapes = tuple(tuple(np.shape(feed[n])) for n in feed_names)
-        key = (id(prog), feed_names, shapes, train, need_grads,
+        key = (id(prog), getattr(prog, "_version", 0), feed_names,
+               shapes, train, need_grads,
                tuple(self._fetch_key(f) for f in fetch_list))
         compiled = self._cache.get(key)
         if compiled is None:
@@ -366,6 +369,76 @@ class Executor:
             return [lookup_fetch(f, env, gvals) for f in fetch_list]
 
         return jax.jit(evalgrad)
+
+
+class _VarHandle:
+    """Scope variable handle (reference framework/scope.cc Variable):
+    get_tensor() reads the current value."""
+
+    def __init__(self, obj):
+        self._obj = obj
+
+    def get_tensor(self):
+        v = getattr(self._obj, "_value", self._obj)
+        if isinstance(v, jax.ShapeDtypeStruct):
+            raise RuntimeError(
+                f"Variable {getattr(self._obj, 'name', '?')!r} has no "
+                "value at graph-build time — run the program first")
+        return np.asarray(v)
+
+    def set_tensor(self, value):
+        import jax.numpy as _jnp
+
+        self._obj._value = _jnp.asarray(value)
+
+
+class Scope:
+    """Name -> variable lookup over the default programs' parameters
+    and feeds (reference Scope name→var tree; values here live on the
+    tensors themselves, so the scope is a view, not storage)."""
+
+    def find_var(self, name):
+        from .graph import _all_programs
+
+        for prog in list(_all_programs):
+            for p in prog.all_parameters():
+                if p.name == name:
+                    return _VarHandle(p)
+            if name in prog._feeds:
+                return _VarHandle(prog._feeds[name])
+            for blk in prog.blocks:
+                if name in blk.vars:
+                    return _VarHandle(blk.vars[name])
+        return None
+
+    var = find_var
+
+
+_scope_state = threading.local()
+
+
+def global_scope():
+    return getattr(_scope_state, "current", None) or _default_scope
+
+
+_default_scope = Scope()
+
+
+def scope_guard(scope):
+    """Install `scope` as the active global scope inside the guard
+    (reference executor.py scope_guard)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        prev = getattr(_scope_state, "current", None)
+        _scope_state.current = scope
+        try:
+            yield scope
+        finally:
+            _scope_state.current = prev
+
+    return guard()
 
 
 class CompiledProgram:

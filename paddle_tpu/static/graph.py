@@ -131,6 +131,13 @@ class Block:
         return ext
 
 
+import weakref as _weakref
+
+# live Programs, weakly held — global_scope() name lookup searches them
+# (the reference's Scope is process-global; ours is a view over tensors)
+_all_programs: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
 class Program:
     """Recorded graph (ProgramDesc analog). `blocks[0]` is the global
     block; control flow adds sub-blocks."""
@@ -138,6 +145,8 @@ class Program:
     _name_counter = [0]
 
     def __init__(self):
+        if _all_programs is not None:
+            _all_programs.add(self)
         self.blocks = [Block(self, 0)]
         self._block_stack = [0]
         self._feeds = {}          # name -> Variable (static.data)

@@ -83,3 +83,19 @@ def test_auto_checkpoint_fresh_run(tmp_path, monkeypatch):
     acp.clear_registry()
     assert list(acp.train_epoch_range(2)) == [0, 1]
     acp.clear_registry()
+
+
+def test_profile_ops_flag_records_counts():
+    import paddle_tpu as paddle2
+    from paddle_tpu.core import monitor as mon
+
+    paddle2.set_flags({"FLAGS_profile_ops": True})
+    try:
+        mon.stat_reset()
+        t = paddle2.to_tensor(np.ones((4, 4), np.float32))
+        _ = paddle2.exp(t)
+        _ = paddle2.exp(t)
+        assert mon.stat_get("op/exp/calls") == 2
+        assert mon.stat_get("op/exp/host_us") >= 0
+    finally:
+        paddle2.set_flags({"FLAGS_profile_ops": False})
