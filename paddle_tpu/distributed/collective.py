@@ -75,14 +75,19 @@ def new_group(ranks=None, backend=None, timeout=None):
     return new_group_for_axes((), ranks=ranks or [])
 
 
-def _select_group_rows(gathered, group):
-    """Multi-process eager: restrict a process_allgather result to the
-    group's member ranks (group=None / world = all processes)."""
-    if group is not None and group.ranks:
-        import numpy as _np
-
-        return gathered[_np.asarray(sorted(group.ranks))]
-    return gathered
+def _require_world_group(group, opname):
+    """Multi-process eager collectives run over the WORLD: the mhu
+    primitives are global barriers, so a rank-subset group — where the
+    reference convention is that only members call — would deadlock
+    (members wait on non-members forever). Refuse loudly; subgroup
+    collectives belong inside compiled steps (mesh-axis groups)."""
+    if (group is not None and group.ranks
+            and len(group.ranks) < jax.process_count()):
+        raise NotImplementedError(
+            f"paddle.distributed.{opname}: eager rank-subset groups are "
+            "not supported across processes (global-barrier transport) "
+            "— run subgroup collectives inside a compiled step over a "
+            "mesh axis, or use the world group")
 
 
 def _reduce_op_fn(op):
@@ -111,13 +116,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         # multi-process eager: each controller holds only its local
         # data — a REAL cross-process reduction is required (VERDICT
         # r1 weak #10: the single-controller identity would be
-        # silently wrong here). Rank-subset groups reduce over only
-        # their members' rows of the gather.
+        # silently wrong here). World group only (see
+        # _require_world_group).
         from jax.experimental import multihost_utils as mhu
 
+        _require_world_group(group, "all_reduce")
         gathered = mhu.process_allgather(
             tensor._value if isinstance(tensor, Tensor) else tensor)
-        gathered = _select_group_rows(gathered, group)
         red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
                ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
                ReduceOp.AVG: jnp.mean}.get(op, jnp.sum)
@@ -147,6 +152,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils as mhu
 
+        _require_world_group(group, "broadcast")
         result = mhu.broadcast_one_to_all(
             tensor._value if isinstance(tensor, Tensor) else tensor,
             is_source=jax.process_index() == src)
@@ -179,9 +185,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils as mhu
 
+        _require_world_group(group, "all_gather")
         gathered = mhu.process_allgather(
             tensor._value if isinstance(tensor, Tensor) else tensor)
-        gathered = _select_group_rows(gathered, group)
         tensor_list.extend(
             Tensor(gathered[i], stop_gradient=True, _internal=True)
             for i in range(gathered.shape[0]))

@@ -1,0 +1,106 @@
+"""Trainer / DeviceWorker family — industrial training loops.
+
+Parity target: paddle/fluid/framework/trainer.h:101
+(TrainerBase/MultiTrainer/DistMultiTrainer) + device_worker.h
+(HogwildWorker, DownpourWorker) + trainer_desc.proto config: N worker
+threads consuming a dataset, asynchronously pulling/pushing sparse
+parameters against the PS.
+
+TPU-native framing: the DENSE model trains on-chip through the
+compiled step; the Trainer family exists for the CPU-side industrial
+CTR workloads whose bulk is sparse-table traffic. HogwildTrainer runs
+lock-free multi-threaded workers (hogwild semantics: racy-but-
+convergent dense updates, per-thread PS pulls); DownpourTrainer adds
+the async PS communicator so grads push in the background —
+`DistMultiTrainer` + `DownpourWorker` in one object.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import AsyncCommunicator, PSClient
+
+__all__ = ["HogwildTrainer", "DownpourTrainer", "TrainerDesc"]
+
+
+class TrainerDesc:
+    """trainer_desc.proto analog: plain config."""
+
+    def __init__(self, thread_num=2, batch_size=32, async_push=False,
+                 sparse_tables=(), lr=0.1):
+        self.thread_num = thread_num
+        self.batch_size = batch_size
+        self.async_push = async_push
+        self.sparse_tables = tuple(sparse_tables)
+        self.lr = lr
+
+
+class HogwildTrainer:
+    """Multi-threaded hogwild loop (device_worker.h HogwildWorker):
+    every thread runs `train_fn(batch, worker_id)` over its shard of
+    the dataset with NO locking around the shared model — the classic
+    lock-free async-SGD recipe. `train_fn` is user code: pull sparse
+    rows, compute grads, update/push."""
+
+    def __init__(self, desc: TrainerDesc):
+        self.desc = desc
+        self._threads = []
+        self._errors = []
+
+    def _worker(self, wid, batches, train_fn):
+        try:
+            for batch in batches:
+                train_fn(batch, wid)
+        except Exception as e:  # surfaced at finalize
+            self._errors.append((wid, e))
+
+    def run(self, batches, train_fn):
+        """batches: a sequence of batches; sharded round-robin across
+        the worker threads (data_feed.cc shard semantics)."""
+        n = self.desc.thread_num
+        shards = [list(batches)[w::n] for w in range(n)]
+        self._threads = [
+            threading.Thread(target=self._worker,
+                             args=(w, shards[w], train_fn), daemon=True)
+            for w in range(n)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def finalize(self, timeout=None):
+        for t in self._threads:
+            t.join(timeout)
+        if self._errors:
+            wid, err = self._errors[0]
+            raise RuntimeError(
+                f"trainer worker {wid} failed: {err!r}") from err
+        return self
+
+
+class DownpourTrainer(HogwildTrainer):
+    """Hogwild threads + async sparse push through the PS communicator
+    (DownpourWorker: pull_sparse -> compute -> push_sparse async)."""
+
+    def __init__(self, desc: TrainerDesc, client: PSClient):
+        super().__init__(desc)
+        self.client = client
+        self.communicator = (AsyncCommunicator(client)
+                             if desc.async_push else None)
+
+    def pull_sparse(self, table, ids):
+        return self.client.pull_sparse(table, ids)
+
+    def push_sparse(self, table, ids, grads, lr=None):
+        lr = lr if lr is not None else self.desc.lr
+        if self.communicator is not None:
+            self.communicator.push_sparse_async(table, ids, grads, lr=lr)
+        else:
+            self.client.push_sparse(table, ids, grads, lr=lr)
+
+    def finalize(self, timeout=None):
+        super().finalize(timeout)
+        if self.communicator is not None:
+            self.communicator.stop()
+        return self

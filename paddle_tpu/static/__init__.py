@@ -389,6 +389,12 @@ class _VarHandle:
     def set_tensor(self, value):
         import jax.numpy as _jnp
 
+        if isinstance(getattr(self._obj, "_value", None),
+                      jax.ShapeDtypeStruct):
+            raise RuntimeError(
+                f"cannot set_tensor on symbolic Variable "
+                f"{getattr(self._obj, 'name', '?')!r} — feed it through "
+                "Executor.run(feed=...) instead")
         self._obj._value = _jnp.asarray(value)
 
 

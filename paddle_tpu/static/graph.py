@@ -145,8 +145,7 @@ class Program:
     _name_counter = [0]
 
     def __init__(self):
-        if _all_programs is not None:
-            _all_programs.add(self)
+        _all_programs.add(self)
         self.blocks = [Block(self, 0)]
         self._block_stack = [0]
         self._feeds = {}          # name -> Variable (static.data)

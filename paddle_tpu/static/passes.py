@@ -85,6 +85,11 @@ class DeadOpEliminationPass(Pass):
             live.add(id(program._loss_var))
         for _, (loss_v, _t) in getattr(program, "_grad_of", {}).items():
             live.add(id(loss_v))
+        if not live:
+            raise ValueError(
+                "dead_op_elimination has no roots — pass keep_vars "
+                "(your fetch targets) or record a loss first; with an "
+                "empty live set the pass would delete the whole graph")
         # Backward slice in reverse op order — transitively dead chains
         # (a -> dead b -> nothing) die in ONE application. Only the
         # global block is sliced: control-flow sub-block ops are

@@ -1,0 +1,103 @@
+"""Trainer/DeviceWorker family over the PS (reference trainer.h:101,
+device_worker.h Hogwild/DownpourWorker) + AOT engine cache in the
+predictor (serialized-TRT-engine analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import PSClient, PSServer
+from paddle_tpu.distributed.ps.trainer import (DownpourTrainer,
+                                               HogwildTrainer,
+                                               TrainerDesc)
+
+
+@pytest.fixture()
+def ps():
+    s = PSServer()
+    c = PSClient([s.endpoint])
+    yield c
+    c.close()
+    s.stop()
+
+
+def test_hogwild_trainer_runs_all_batches():
+    counts = []
+    import threading
+
+    lock = threading.Lock()
+
+    def train_fn(batch, wid):
+        with lock:
+            counts.append((wid, batch))
+
+    desc = TrainerDesc(thread_num=3)
+    HogwildTrainer(desc).run(range(12), train_fn).finalize()
+    assert len(counts) == 12
+    assert {w for w, _ in counts} == {0, 1, 2}
+
+
+def test_hogwild_trainer_propagates_worker_error():
+    def train_fn(batch, wid):
+        if batch == 3:
+            raise ValueError("bad batch")
+
+    desc = TrainerDesc(thread_num=2)
+    with pytest.raises(RuntimeError, match="worker .* failed"):
+        HogwildTrainer(desc).run(range(6), train_fn).finalize()
+
+
+def test_downpour_trainer_ctr_style(ps):
+    """Multi-threaded async sparse training converges: each worker
+    pulls rows, computes a grad, pushes async."""
+    ps.create_sparse_table("ctr", emb_dim=4, initializer="zeros")
+    desc = TrainerDesc(thread_num=2, async_push=True, lr=1.0)
+    trainer = DownpourTrainer(desc, ps)
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, 50, (8,)).astype(np.int64)
+               for _ in range(10)]
+
+    def train_fn(ids, wid):
+        rows = trainer.pull_sparse("ctr", ids)
+        grad = np.ones_like(rows)  # push toward -1 per touch
+        trainer.push_sparse("ctr", ids, grad)
+
+    trainer.run(batches, train_fn).finalize()
+    touched = np.unique(np.concatenate(batches))
+    rows = ps.pull_sparse("ctr", touched)
+    assert (rows < 0).all()  # every touched row moved negative
+    assert ps.sparse_size("ctr") == len(touched)
+
+
+def test_predictor_aot_engine_cache(tmp_path):
+    """Config.set_optim_cache_dir: first run serializes the compiled
+    executable; a fresh predictor loads it and matches outputs."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    prefix = str(tmp_path / "m")
+    jit_save(net, prefix, input_spec=[InputSpec([4, 8], "float32")])
+
+    xv = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(xv))._value)
+
+    cache = str(tmp_path / "engines")
+    cfg = Config(prefix)
+    cfg.set_optim_cache_dir(cache)
+    p1 = create_predictor(cfg)
+    out1 = p1.run([xv])
+    np.testing.assert_allclose(out1[0], ref, rtol=1e-5, atol=1e-6)
+    import os
+
+    engines = [f for f in os.listdir(cache) if f.endswith(".pdexec")]
+    assert len(engines) == 1
+
+    # fresh predictor: loads the serialized engine (same file, no new)
+    cfg2 = Config(prefix)
+    cfg2.set_optim_cache_dir(cache)
+    p2 = create_predictor(cfg2)
+    out2 = p2.run([xv])
+    np.testing.assert_allclose(out2[0], ref, rtol=1e-5, atol=1e-6)
+    assert len(os.listdir(cache)) == 1
