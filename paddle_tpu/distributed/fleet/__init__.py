@@ -108,6 +108,10 @@ class _DistributedOptimizer:
         return self._inner.clear_grad(*a, **k)
 
 
+from .meta_optimizer_factory import (apply_strategy,
+                                     build_strategy_train_step)
+
+
 def distributed_optimizer(optimizer, strategy=None):
     if strategy is not None:
         _fleet_state["strategy"] = strategy

@@ -57,10 +57,11 @@ class HogwildTrainer:
             self._errors.append((wid, e))
 
     def run(self, batches, train_fn):
-        """batches: a sequence of batches; sharded round-robin across
-        the worker threads (data_feed.cc shard semantics)."""
+        """batches: a sequence/iterator of batches; sharded round-robin
+        across the worker threads (data_feed.cc shard semantics)."""
         n = self.desc.thread_num
-        shards = [list(batches)[w::n] for w in range(n)]
+        items = list(batches)  # materialize ONCE (iterators included)
+        shards = [items[w::n] for w in range(n)]
         self._threads = [
             threading.Thread(target=self._worker,
                              args=(w, shards[w], train_fn), daemon=True)
@@ -70,8 +71,15 @@ class HogwildTrainer:
         return self
 
     def finalize(self, timeout=None):
+        import time
+
+        deadline = (time.time() + timeout) if timeout else None
         for t in self._threads:
-            t.join(timeout)
+            t.join(None if deadline is None
+                   else max(deadline - time.time(), 0.0))
+        if any(t.is_alive() for t in self._threads):
+            raise RuntimeError(
+                f"trainer: workers still running after {timeout}s")
         if self._errors:
             wid, err = self._errors[0]
             raise RuntimeError(
@@ -100,7 +108,12 @@ class DownpourTrainer(HogwildTrainer):
             self.client.push_sparse(table, ids, grads, lr=lr)
 
     def finalize(self, timeout=None):
-        super().finalize(timeout)
-        if self.communicator is not None:
-            self.communicator.stop()
+        try:
+            super().finalize(timeout)
+        finally:
+            # stop+flush the async pusher even when a worker failed —
+            # healthy workers' queued grads must reach the PS and the
+            # background thread must not outlive the trainer
+            if self.communicator is not None:
+                self.communicator.stop()
         return self

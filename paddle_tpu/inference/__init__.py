@@ -188,14 +188,18 @@ class Predictor:
             compiled = jax.jit(fn).lower(*specs).compile()
             blob, in_tree, out_tree = se.serialize(compiled)
             os.makedirs(self._cache_dir, exist_ok=True)
-            with open(path, "wb") as f:
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
                 pickle.dump((blob, in_tree, out_tree), f)
+            os.replace(tmp, path)  # atomic: no torn engines
             loaded = se.deserialize_and_load(blob, in_tree, out_tree,
                                              execution_devices=[dev])
 
+        placed_params = [jax.device_put(v, sds) for v in param_vals()]
+
         def exe(*xs):
-            args = list(param_vals()) + list(xs)
-            return loaded(*[jax.device_put(x, sds) for x in args])
+            return loaded(*placed_params,
+                          *[jax.device_put(x, sds) for x in xs])
 
         self._aot[sig] = exe
         return exe

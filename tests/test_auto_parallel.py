@@ -89,10 +89,13 @@ def test_engine_fit_decreases_loss():
         def __getitem__(self, i):
             return self.x[i], self.y[i]
 
+    paddle.seed(0)
     model = nn.Linear(16, 1)
     eng = Engine(model=model,
                  loss=lambda out, y: ((out - y) ** 2).mean(),
                  optimizer=optim.Adam(learning_rate=1e-2,
                                       parameters=model.parameters()))
-    hist = eng.fit(Reg(), epochs=3, batch_size=16)
-    assert hist[-1] < hist[0]
+    hist = eng.fit(Reg(), epochs=4, batch_size=16)
+    per_epoch = np.asarray(hist).reshape(4, -1).mean(axis=1)
+    # epoch-mean loss decreases (single shuffled batches are noisy)
+    assert per_epoch[-1] < per_epoch[0]
