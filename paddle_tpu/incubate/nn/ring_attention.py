@@ -33,7 +33,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...distributed import mesh as mesh_mod
 
-__all__ = ["ring_attention", "ring_attention_shard"]
+__all__ = ["ring_attention", "ring_attention_shard",
+           "ulysses_attention"]
 
 
 def _chunk_attn_partial(q, k_blk, v_blk, q_off, k_off, causal, sm_scale):
@@ -125,6 +126,63 @@ def _shard_map(body, mesh, in_specs, out_specs):
     except TypeError:
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False)
+
+
+def ulysses_attention(q, k, v, causal=True, sm_scale=None, mesh=None,
+                      batch_axis="dp", seq_axis="sp"):
+    """Ulysses/DeepSpeed-style sequence parallelism (SURVEY §5:
+    "Ulysses-style head-sharded alltoall"): inputs arrive sharded over
+    the SEQUENCE dim; one all_to_all re-shards them over the HEAD dim
+    (each sp-rank then holds h/sp full-sequence heads), attention runs
+    LOCALLY and exactly (any kernel — here the dense/flash path), and a
+    second all_to_all restores sequence sharding.
+
+    Two all_to_alls of the qkv/out tensors vs ring's sp ppermutes of
+    KV — Ulysses wins when heads >> sp and attention is kernel-bound;
+    ring wins on memory for extreme sequence lengths. Requires
+    num_heads % sp == 0.
+
+    Known host-emulation limitation: XLA:CPU's concurrent thunk
+    executor can deadlock when this cross-module all_to_all overlaps
+    other collectives at certain shapes (rendezvous ordering races in
+    the in-process communicator). The TPU runtime schedules
+    collectives consistently and is unaffected; on CPU test meshes
+    prefer ring attention for large head counts."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if (mesh is None or seq_axis not in mesh.shape
+            or mesh.shape[seq_axis] <= 1):
+        return _dense_causal_attention(q, k, v, causal, sm_scale)
+    sp = mesh.shape[seq_axis]
+    b, h, s, d = q.shape
+    if h % sp or s % sp:
+        return _dense_causal_attention(q, k, v, causal, sm_scale)
+
+    def pick(a, dim):
+        return a if (a in mesh.shape and mesh.shape[a] > 1
+                     and dim % mesh.shape[a] == 0) else None
+
+    bax = pick(batch_axis, b)
+    in_spec = P(bax, None, seq_axis, None)   # seq-sharded in/out
+    out_spec = in_spec
+
+    def body(qs, ks, vs):
+        # [b, h, s/sp, d] per rank -> tiled all_to_all: scatter the
+        # HEAD dim, gather the SEQ dim -> [b, h/sp, s, d] full-sequence
+        # heads; the inverse swap restores sequence sharding.
+        def seq2head(x):
+            return lax.all_to_all(x, seq_axis, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+        def head2seq(x):
+            return lax.all_to_all(x, seq_axis, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+        qh, kh, vh = seq2head(qs), seq2head(ks), seq2head(vs)
+        oh = _dense_causal_attention(qh, kh, vh, causal, sm_scale)
+        return head2seq(oh)
+
+    return _shard_map(body, mesh, (in_spec, in_spec, in_spec),
+                      out_spec)(q, k, v)
 
 
 def ring_attention(q, k, v, causal=True, sm_scale=None, mesh=None,

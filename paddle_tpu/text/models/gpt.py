@@ -47,10 +47,12 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     use_flash_attention: bool = True
-    # sequence-parallel ring attention over the 'sp' mesh axis (KV
-    # blocks rotate via collective-permute; exact, O(S/sp) memory per
-    # chip). Engages only when the live mesh has sp > 1.
+    # sequence-parallel attention over the 'sp' mesh axis. Engages
+    # only when the live mesh has sp > 1. sp_attention picks the
+    # algorithm: "ring" (KV ppermute ring, O(S/sp) memory) or
+    # "ulysses" (head-sharded all_to_all — cheaper when heads >> sp).
     use_ring_attention: bool = False
+    sp_attention: str = "ring"
     remat: bool = True  # jax.checkpoint each block (recompute analog)
     # selective remat: None = save nothing (full recompute);
     # "dots" = save matmul/einsum outputs, recompute elementwise only
@@ -94,11 +96,14 @@ def _attention(q, k, v, n_head, use_flash, use_ring=False):
     v = v.reshape(b, s, n_head, d).transpose(0, 2, 1, 3)
     scale = 1.0 / math.sqrt(d)
     if use_ring:
-        # ring_attention owns ALL fallback logic (no mesh / sp==1 /
-        # indivisible seq -> exact dense attention)
-        from ...incubate.nn.ring_attention import ring_attention
+        # the sp-attention entries own ALL fallback logic (no mesh /
+        # sp==1 / indivisible dims -> exact dense attention)
+        from ...incubate.nn.ring_attention import (ring_attention,
+                                                   ulysses_attention)
 
-        out = ring_attention(q, k, v, causal=True, sm_scale=scale)
+        attn_fn = (ulysses_attention if use_ring == "ulysses"
+                   else ring_attention)
+        out = attn_fn(q, k, v, causal=True, sm_scale=scale)
         return out.transpose(0, 2, 1, 3).reshape(b, s, h)
     if use_flash:
         try:
@@ -309,7 +314,8 @@ class GPTModel(Layer):
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
-                        use_ring=c.use_ring_attention,
+                        use_ring=(c.sp_attention
+                                  if c.use_ring_attention else False),
                         pp_schedule=c.pp_schedule,
                         remat_policy=c.remat_policy)
 
@@ -332,7 +338,8 @@ class GPTForCausalLM(Layer):
                         use_flash=c.use_flash_attention, remat=c.remat,
                         dropout=drop, key=key, pp_stages=c.pp_num_stages,
                         pp_microbatches=c.pp_microbatches,
-                        use_ring=c.use_ring_attention,
+                        use_ring=(c.sp_attention
+                                  if c.use_ring_attention else False),
                         pp_schedule=c.pp_schedule,
                         remat_policy=c.remat_policy)
 
