@@ -118,6 +118,23 @@ def _dense_causal_attention(q, k, v, causal, sm_scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _sp_mesh_or_none(mesh, seq_axis):
+    """Resolve the live mesh for sequence parallelism; None means
+    'no sp axis > 1 — fall back to exact dense attention'."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if (mesh is None or seq_axis not in mesh.shape
+            or mesh.shape[seq_axis] <= 1):
+        return None
+    return mesh
+
+
+def _pick_axis(mesh, a, dim):
+    """Use mesh axis `a` for a tensor dim only when it exists, is >1,
+    and divides the dim."""
+    return a if (a in mesh.shape and mesh.shape[a] > 1
+                 and dim % mesh.shape[a] == 0) else None
+
+
 def _shard_map(body, mesh, in_specs, out_specs):
     """shard_map across jax versions (check_vma was check_rep)."""
     try:
@@ -129,7 +146,7 @@ def _shard_map(body, mesh, in_specs, out_specs):
 
 
 def ulysses_attention(q, k, v, causal=True, sm_scale=None, mesh=None,
-                      batch_axis="dp", seq_axis="sp"):
+                      batch_axis="dp", head_axis="mp", seq_axis="sp"):
     """Ulysses/DeepSpeed-style sequence parallelism (SURVEY §5:
     "Ulysses-style head-sharded alltoall"): inputs arrive sharded over
     the SEQUENCE dim; one all_to_all re-shards them over the HEAD dim
@@ -148,21 +165,23 @@ def ulysses_attention(q, k, v, causal=True, sm_scale=None, mesh=None,
     the in-process communicator). The TPU runtime schedules
     collectives consistently and is unaffected; on CPU test meshes
     prefer ring attention for large head counts."""
-    mesh = mesh or mesh_mod.get_mesh()
-    if (mesh is None or seq_axis not in mesh.shape
-            or mesh.shape[seq_axis] <= 1):
+    mesh = _sp_mesh_or_none(mesh, seq_axis)
+    if mesh is None:
         return _dense_causal_attention(q, k, v, causal, sm_scale)
     sp = mesh.shape[seq_axis]
     b, h, s, d = q.shape
     if h % sp or s % sp:
         return _dense_causal_attention(q, k, v, causal, sm_scale)
 
-    def pick(a, dim):
-        return a if (a in mesh.shape and mesh.shape[a] > 1
-                     and dim % mesh.shape[a] == 0) else None
-
-    bax = pick(batch_axis, b)
-    in_spec = P(bax, None, seq_axis, None)   # seq-sharded in/out
+    bax = _pick_axis(mesh, batch_axis, b)
+    # heads may ALSO stay sharded over the tensor-parallel axis: the
+    # island's local all_to_all then splits the per-mp-rank head count
+    # by sp, which requires h % (mp * sp) == 0; otherwise heads
+    # replicate over mp inside the island (correct, just redundant)
+    mp_n = mesh.shape.get(head_axis, 1)
+    hax = (head_axis if (head_axis in mesh.shape and mp_n > 1
+                         and h % (mp_n * sp) == 0) else None)
+    in_spec = P(bax, hax, seq_axis, None)   # seq-sharded in/out
     out_spec = in_spec
 
     def body(qs, ks, vs):
@@ -191,18 +210,13 @@ def ring_attention(q, k, v, causal=True, sm_scale=None, mesh=None,
     seq_axis). q/k/v: [b, h, s, d] global. Valid inside jit — GSPMD
     reshards surroundings to match. Falls back to single-shard exact
     attention when the mesh has no sp axis > 1."""
-    mesh = mesh or mesh_mod.get_mesh()
-    if (mesh is None or seq_axis not in mesh.shape
-            or mesh.shape[seq_axis] <= 1):
+    mesh = _sp_mesh_or_none(mesh, seq_axis)
+    if mesh is None:
         return _dense_causal_attention(q, k, v, causal, sm_scale)
-
-    def pick(a, dim):
-        return a if (a in mesh.shape and mesh.shape[a] > 1
-                     and dim % mesh.shape[a] == 0) else None
-
     if q.shape[2] % mesh.shape[seq_axis]:
         return _dense_causal_attention(q, k, v, causal, sm_scale)
-    spec = P(pick(batch_axis, q.shape[0]), pick(head_axis, q.shape[1]),
+    spec = P(_pick_axis(mesh, batch_axis, q.shape[0]),
+             _pick_axis(mesh, head_axis, q.shape[1]),
              seq_axis, None)
     body = functools.partial(ring_attention_shard, axis_name=seq_axis,
                              causal=causal, sm_scale=sm_scale)

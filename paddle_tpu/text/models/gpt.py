@@ -101,6 +101,10 @@ def _attention(q, k, v, n_head, use_flash, use_ring=False):
         from ...incubate.nn.ring_attention import (ring_attention,
                                                    ulysses_attention)
 
+        if use_ring not in (True, "ring", "ulysses"):
+            raise ValueError(
+                f"sp_attention must be 'ring' or 'ulysses', got "
+                f"{use_ring!r}")
         attn_fn = (ulysses_attention if use_ring == "ulysses"
                    else ring_attention)
         out = attn_fn(q, k, v, causal=True, sm_scale=scale)

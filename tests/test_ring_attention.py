@@ -182,3 +182,15 @@ def test_gpt_ulysses_loss_matches_dense():
         set_mesh(None)
     np.testing.assert_allclose(losses["ulysses"], losses["dense"],
                                rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_composes_with_mp_head_sharding():
+    from paddle_tpu.incubate.nn.ring_attention import ulysses_attention
+
+    q, k, v = _qkv(b=2, h=8, s=32, d=4, seed=12)
+    ref = _dense_causal_attention(q, k, v, True, None)
+    mesh = build_mesh({"mp": 2, "sp": 4})  # h=8 % (2*4) == 0
+    set_mesh(mesh)
+    out = jax.jit(lambda a, b_, c: ulysses_attention(a, b_, c))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
