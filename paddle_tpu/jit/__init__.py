@@ -75,6 +75,31 @@ def _collect_layers(func, args):
     return layers
 
 
+def _freeze_static(v):
+    """Hashable cache-key form of a static (non-Tensor) argument.
+    Arrays hash by CONTENT digest — repr() truncates big arrays and
+    would silently collide distinct values into one compiled program."""
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        pass
+    if isinstance(v, np.ndarray):
+        import hashlib
+
+        return ("ndarray", v.shape, str(v.dtype),
+                hashlib.sha256(np.ascontiguousarray(v).tobytes())
+                .digest())
+    try:
+        import hashlib
+        import pickle
+
+        return ("pickled",
+                hashlib.sha256(pickle.dumps(v)).digest())
+    except Exception:
+        return ("id", id(v))
+
+
 class StaticFunction:
     """Compiled wrapper (reference: StaticFunction,
     program_translator.py:236)."""
@@ -82,6 +107,13 @@ class StaticFunction:
     def __init__(self, func, input_spec=None, build_strategy=None,
                  backend=None):
         self._func = func
+        # dy2static AST pass: rewrite data-dependent if/while into
+        # lax.cond/while_loop converter calls (reference
+        # ProgramTranslator AST transformers); falls back to trace-only
+        # conversion when the source can't be transformed
+        from .dy2static import ast_transform
+
+        self._trace_target = ast_transform(func) or func
         self._input_spec = input_spec
         self._compiled = {}
         functools.update_wrapper(self, func,
@@ -90,9 +122,14 @@ class StaticFunction:
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        bound = StaticFunction(self._func.__get__(instance, owner),
-                               self._input_spec)
+        bound = StaticFunction.__new__(StaticFunction)
+        bound._func = self._func.__get__(instance, owner)
+        bound._trace_target = self._trace_target.__get__(instance, owner) \
+            if self._trace_target is not self._func else bound._func
+        bound._input_spec = self._input_spec
         bound._compiled = self._compiled
+        functools.update_wrapper(bound, bound._func,
+                                 assigned=("__name__", "__doc__"))
         return bound
 
     @property
@@ -102,8 +139,8 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         from ..nn import Layer
 
-        target = self._func
-        layers = _collect_layers(target, args)
+        target = self._trace_target
+        layers = _collect_layers(self._func, args)
         params = []
         for lay in layers:
             params.extend(p for _, p in lay.named_parameters())
@@ -119,7 +156,8 @@ class StaticFunction:
 
         key = (args_treedef, tuple(tensor_pos),
                tuple((tuple(flat_args[i].shape), str(flat_args[i].dtype))
-                     for i in tensor_pos), tuple(param_ids))
+                     for i in tensor_pos), tuple(param_ids),
+               tuple(_freeze_static(v) for v in static_leaves))
         entry = self._compiled.get(key)
         if entry is None:
             entry = self._build(target, params, args_treedef, tensor_pos,

@@ -1,0 +1,405 @@
+"""dy2static — AST transformation of data-dependent Python control
+flow.
+
+Parity target: python/paddle/fluid/dygraph/dygraph_to_static/ — the
+reference rewrites ~20 syntax forms (ifelse_transformer.py,
+loop_transformer.py, ...) into `convert_ifelse` / `convert_while`
+runtime calls that dispatch on whether the condition is a Tensor
+(program_translator.py:775 ProgramTranslator).
+
+TPU-native design: the same two-phase shape. An ast.NodeTransformer
+rewrites `if`/`while` statements into calls of the runtime converters
+below; at trace time a traced (tracer-backed) condition lowers to
+`lax.cond` / `lax.while_loop` (XLA control flow — SURVEY §7 step 4),
+while a concrete condition takes the plain Python branch, so the SAME
+transformed function serves eager and compiled execution.
+
+Scope (documented restrictions, enforced with clear errors + automatic
+fallback to trace-only conversion): no `return`/`break`/`continue`
+inside converted bodies, and the source must be available to
+`inspect.getsource`. Closures are supported by factory re-binding
+(cells are captured by value at conversion time — the reference's
+limitation too); names first bound inside a branch surface as an
+UNDEF sentinel when the other branch is taken (UndefinedVar analog).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_ifelse", "convert_while", "ast_transform"]
+
+
+def _unwrap(v):
+    from ..core.tensor import Tensor
+
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+def _wrap(v):
+    from ..core.tensor import Tensor
+
+    return Tensor(v, stop_gradient=False, _internal=True)
+
+
+def _truthy(p):
+    """Plain Python truthiness for ordinary objects; array semantics
+    only for actual arrays (a rewritten `if some_list:` must behave
+    exactly as it did un-rewritten)."""
+    if isinstance(p, (jax.Array, np.ndarray, np.generic)):
+        return bool(np.asarray(p))
+    return bool(p)
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (reference convert_operators.py convert_ifelse /
+# convert_while_loop)
+# ---------------------------------------------------------------------------
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """Tensor pred (traced) -> lax.cond over both branches; concrete
+    pred -> plain Python dispatch. Branch fns take no args and return
+    the tuple of names assigned in either branch."""
+    p = _unwrap(pred)
+    if _is_traced(p):
+        def wrap_branch(fn):
+            def g(_):
+                vals = fn()
+                return tuple(jnp.asarray(_unwrap(v)) for v in vals)
+
+            return g
+
+        pv = jnp.reshape(jnp.asarray(p), ()).astype(bool)
+        outs = jax.lax.cond(pv, wrap_branch(true_fn),
+                            wrap_branch(false_fn), None)
+        return tuple(_wrap(o) for o in outs)
+    taken = true_fn if _truthy(p) else false_fn
+    return tuple(taken())
+
+
+def convert_while(cond_fn, body_fn, init_vals):
+    """Tensor condition or traced loop state -> lax.while_loop;
+    otherwise a plain Python loop. cond_fn/body_fn take the loop vars
+    positionally; body_fn returns their updated tuple.
+
+    Differentiation note: XLA's `while` has no general reverse-mode
+    rule (dynamic trip count), so converted `while` loops support
+    forward/inference and paths whose loop carry needs no gradient
+    (counters, stopping criteria under stop_gradient). Gradients
+    through a dynamic loop carry raise jax's clear error; use
+    fixed-trip-count Python `for` loops (unrolled at trace time) or
+    `lax.scan`-style ops for differentiable iteration — the same
+    boundary the reference's static While places on its users in
+    practice."""
+    init_vals = tuple(init_vals)
+    p0 = cond_fn(*init_vals)
+    if _is_traced(p0) or any(_is_traced(v) for v in init_vals):
+        def cond_c(vals):
+            r = cond_fn(*[_wrap(v) for v in vals])
+            return jnp.reshape(jnp.asarray(_unwrap(r)), ()).astype(bool)
+
+        def body_c(vals):
+            outs = body_fn(*[_wrap(v) for v in vals])
+            return tuple(jnp.asarray(_unwrap(o)) for o in outs)
+
+        outs = jax.lax.while_loop(
+            cond_c, body_c,
+            tuple(jnp.asarray(_unwrap(v)) for v in init_vals))
+        return tuple(_wrap(o) for o in outs)
+    vals = init_vals
+    while _truthy(_unwrap(cond_fn(*vals))):
+        vals = tuple(body_fn(*vals))
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# AST transformer (reference ifelse_transformer.py / loop_transformer.py)
+# ---------------------------------------------------------------------------
+
+class _Unsupported(Exception):
+    pass
+
+
+class _Undefined:
+    """Sentinel for names assigned only inside some branch (the
+    reference's UndefinedVar): reading it downstream fails loudly."""
+
+    def __repr__(self):
+        return "<undefined branch variable>"
+
+
+UNDEF = _Undefined()
+
+
+def _assigned_names(nodes):
+    """Simple names assigned anywhere in the statement list (not
+    descending into nested function defs)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # don't descend
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._collect(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def _collect(self, t):
+            if isinstance(t, ast.Name):
+                if t.id not in names:
+                    names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._collect(e)
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return names
+
+
+def _check_no_flow_escape(nodes):
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Return(self, node):
+            raise _Unsupported("return inside converted control flow")
+
+        def visit_Break(self, node):
+            raise _Unsupported("break inside converted control flow")
+
+        def visit_Continue(self, node):
+            raise _Unsupported("continue inside converted control flow")
+
+    for n in nodes:
+        V().visit(n)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__jst_{kind}_{self._n}"
+
+    def _names_tuple(self, names, ctx):
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def _undef_guards(self, names):
+        """Pre-seed names first bound inside the construct with the
+        UNDEF sentinel so def-time reads don't NameError (reference
+        UndefinedVar)."""
+        guards = []
+        for n in names:
+            guards.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=n, ctx=ast.Store())],
+                        value=ast.Attribute(
+                            value=ast.Name(id="_jst", ctx=ast.Load()),
+                            attr="UNDEF", ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return guards
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        _check_no_flow_escape(node.body)
+        _check_no_flow_escape(node.orelse)
+        names = _assigned_names(node.body + node.orelse)
+        tname, fname = self._fresh("true"), self._fresh("false")
+        # each branch takes the assigned names as DEFAULT arguments
+        # bound at def time: a branch can read a name it also assigns
+        # (`acc = acc + 1`), and — crucial under lax.cond, which traces
+        # BOTH branches — neither branch's trace can leak state into
+        # the other (nonlocal mutation would).
+        brargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[ast.Name(id=n, ctx=ast.Load()) for n in names])
+        guards = self._undef_guards(names)
+        ret = ast.Return(value=self._names_tuple(names, ast.Load))
+        tdef = ast.FunctionDef(
+            name=tname, args=brargs,
+            body=list(node.body) + [ret],
+            decorator_list=[])
+        fdef = ast.FunctionDef(
+            name=fname, args=brargs,
+            body=(list(node.orelse) or [ast.Pass()]) + [
+                ast.Return(value=self._names_tuple(names, ast.Load))],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                               attr="convert_ifelse", ctx=ast.Load()),
+            args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load())], keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[self._names_tuple(names, ast.Store)], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return guards + [tdef, fdef, assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise _Unsupported("while/else")
+        _check_no_flow_escape(node.body)
+        names = _assigned_names(node.body)
+        if not names:
+            return node  # stateless loop: leave as python
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        guards = self._undef_guards(names)
+        argdef = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cdef = ast.FunctionDef(
+            name=cname, args=argdef,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        bdef = ast.FunctionDef(
+            name=bname, args=argdef,
+            body=list(node.body) + [
+                ast.Return(value=self._names_tuple(names, ast.Load))],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                               attr="convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  self._names_tuple(names, ast.Load)], keywords=[])
+        assign = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)], value=call)
+        return guards + [cdef, bdef, assign]
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def ast_transform(func):
+    """Rewrite func's if/while into converter calls; returns the new
+    function, or None when conversion is unavailable (no source,
+    closures, unsupported constructs) — callers fall back to
+    trace-only conversion, matching the reference's graceful
+    degradation."""
+    bound_self = None
+    if inspect.ismethod(func):
+        bound_self = func.__self__
+        func = func.__func__
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    # drop only the to_static-family decorators; any OTHER decorator
+    # re-applies so the transformed target keeps its runtime behavior
+    def _is_to_static_deco(d):
+        expr = d.func if isinstance(d, ast.Call) else d
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        return name in ("to_static", "not_to_static")
+
+    fdef.decorator_list = [d for d in fdef.decorator_list
+                           if not _is_to_static_deco(d)]
+    has_cf = any(isinstance(n, (ast.If, ast.While))
+                 for n in ast.walk(fdef))
+    if not has_cf:
+        return None  # nothing to do — keep the original
+    try:
+        new_tree = _ControlFlowTransformer().visit(tree)
+    except _Unsupported:
+        return None
+    ast.fix_missing_locations(new_tree)
+    from . import dy2static as _jst_mod
+
+    glb = dict(func.__globals__)
+    glb["_jst"] = _jst_mod
+    closure = getattr(func, "__closure__", None) or ()
+    freevars = func.__code__.co_freevars
+    if closure:
+        # rebuild the closure: wrap the transformed def in a factory
+        # taking the free variables as parameters (cells re-bound to
+        # their CURRENT contents — the standard dy2static limitation)
+        try:
+            cells = [c.cell_contents for c in closure]
+        except ValueError:
+            return None
+        factory = ast.FunctionDef(
+            name="__jst_factory",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(
+                value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+            decorator_list=[])
+        new_tree = ast.Module(body=[factory], type_ignores=[])
+        ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename=f"<dy2static:{func.__name__}>",
+                       mode="exec")
+        exec(code, glb)
+    except Exception:
+        return None
+    if closure:
+        try:
+            new_fn = glb["__jst_factory"](*cells)
+        except Exception:
+            return None
+    else:
+        new_fn = glb.get(fdef.name)
+    if new_fn is None:
+        return None
+    try:
+        functools.update_wrapper(new_fn, func)
+    except AttributeError:
+        pass
+    if bound_self is not None:
+        new_fn = new_fn.__get__(bound_self)
+    return new_fn

@@ -1,0 +1,210 @@
+"""dy2static AST transformation (reference:
+dygraph_to_static/ifelse_transformer.py, loop_transformer.py,
+convert_operators.py): data-dependent Python if/while under @to_static
+lower to lax.cond / lax.while_loop."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ast_transform
+
+
+def test_data_dependent_if_compiles_both_branches():
+    @to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    xp = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xp)._value), 2.0)
+    xn = paddle.to_tensor(-np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xn)._value), -2.0)
+
+
+def test_data_dependent_while_loop():
+    @to_static
+    def f(x):
+        i = paddle.zeros([1], "float32")
+        s = paddle.zeros([1], "float32")
+        while paddle.sum(i) < 4:
+            s = s + paddle.mean(x)
+            i = i + 1
+        return s
+
+    x = paddle.to_tensor(np.full((2,), 3.0, np.float32))
+    assert abs(float(f(x).item()) - 12.0) < 1e-5
+
+
+def test_elif_chain():
+    @to_static
+    def f(x):
+        m = paddle.mean(x)
+        if m > 1.0:
+            y = x * 10.0
+        elif m > 0.0:
+            y = x * 2.0
+        else:
+            y = x * 0.0
+        return y
+
+    mk = lambda v: paddle.to_tensor(np.full((2,), v, np.float32))
+    np.testing.assert_allclose(np.asarray(f(mk(2.0))._value), 20.0)
+    np.testing.assert_allclose(np.asarray(f(mk(0.5))._value), 1.0)
+    np.testing.assert_allclose(np.asarray(f(mk(-1.0))._value), 0.0)
+
+
+def test_python_bool_condition_still_python():
+    """Concrete (non-tensor) conditions keep plain Python dispatch —
+    including shape-dependent logic at trace time."""
+    @to_static
+    def f(x, flag):
+        if flag:  # python bool: resolved at trace time
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x, True)._value), 1.0)
+    np.testing.assert_allclose(np.asarray(f(x, False)._value), -1.0)
+
+
+def test_nested_if_inside_while():
+    @to_static
+    def f(x):
+        i = paddle.zeros([1], "float32")
+        acc = paddle.zeros([1], "float32")
+        while paddle.sum(i) < 4:
+            if paddle.sum(i) - 2.0 < 0:
+                acc = acc + 1.0
+            else:
+                acc = acc + 10.0
+            i = i + 1
+        return acc
+
+    x = paddle.to_tensor(np.zeros((1,), np.float32))
+    # i = 0,1 -> +1 each; i = 2,3 -> +10 each
+    assert abs(float(f(x).item()) - 22.0) < 1e-5
+
+
+def test_training_through_converted_control_flow():
+    """Gradients flow through lax.cond/while via the run_program op."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+
+    @to_static
+    def step_fn(x):
+        h = lin(x)
+        if paddle.mean(h) > 1000.0:  # never taken, but compiled
+            h = h * 0.0
+        else:
+            h = h * 1.0
+        return (h ** 2).mean()
+
+    opt = optim.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    losses = []
+    for _ in range(5):
+        loss = step_fn(x)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_unsupported_constructs_fall_back():
+    def f_with_return(x):
+        if True:
+            return x
+        return x + 1
+
+    # return inside if -> fallback (None), caller uses trace-only
+    assert ast_transform(f_with_return) is None
+
+    y = 3.0
+
+    def f_with_closure(x):
+        if x:
+            z = x + y
+        else:
+            z = x
+        return z
+
+    # closures are supported via factory re-binding
+    conv = ast_transform(f_with_closure)
+    assert conv is not None
+    assert conv(2.0) == (5.0,)[0] or conv(2.0) == 5.0
+
+
+def test_transform_skips_functions_without_control_flow():
+    def plain(x):
+        return x * 2
+
+    assert ast_transform(plain) is None
+
+
+def test_layer_forward_method_with_control_flow():
+    """Bound methods (Layer.forward) convert correctly (round-2
+    review: unbound rebuild crashed)."""
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        @to_static
+        def forward(self, x):
+            h = self.lin(x)
+            if paddle.mean(h) > 1000.0:
+                h = h * 0.0
+            else:
+                h = h * 2.0
+            return h
+
+    paddle.seed(0)
+    m = M()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = m(x)
+    ref = np.asarray(m.lin(x)._value) * 2.0
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+
+def test_python_container_condition():
+    """`if some_list:` keeps plain truthiness after the rewrite."""
+    @to_static
+    def f(x, items):
+        if items:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x, (1, 2))._value), 1.0)
+    np.testing.assert_allclose(np.asarray(f(x, ())._value), -1.0)
+
+
+def test_static_arg_cache_distinguishes_array_values():
+    """Static ndarray args key by content digest, not repr (round-2
+    review: repr truncation collided large arrays)."""
+    @to_static
+    def f(x, table):
+        return x + float(np.sum(table))
+
+    x = paddle.to_tensor(np.zeros((2,), np.float32))
+    a = np.ones(10_000, np.float32)
+    b = np.ones(10_000, np.float32)
+    b[5000] = 3.0
+    ra = float(np.asarray(f(x, a)._value)[0])
+    rb = float(np.asarray(f(x, b)._value)[0])
+    assert abs(ra - 10_000.0) < 1e-3
+    assert abs(rb - 10_002.0) < 1e-3
