@@ -1,0 +1,35 @@
+"""C API build helper (reference: inference/capi_exp + goapi — the
+C ABI other languages bind to; Go/R wrap exactly this kind of header).
+
+`build_capi()` compiles libpd_inference.so from pd_inference_api.cc
+(embedding CPython) through the cpp_extension toolchain and returns
+its path; C programs include pd_inference_api.h and link against it
+plus libpython."""
+from __future__ import annotations
+
+import os
+import sysconfig
+
+__all__ = ["build_capi", "header_path"]
+
+
+def header_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "pd_inference_api.h")
+
+
+def build_capi(verbose=False):
+    """Compile the C API shared library; returns the .so path."""
+    from ...utils.cpp_extension import get_build_directory, load
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "pd_inference_api.cc")
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    lib = load("pd_inference", [src],
+               extra_cxx_flags=[f"-I{inc}", f"-I{here}"],
+               extra_ldflags=[f"-L{libdir}", f"-lpython{ver}"],
+               verbose=verbose)
+    return lib._name

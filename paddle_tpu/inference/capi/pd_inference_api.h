@@ -1,0 +1,63 @@
+/* C inference API.
+ *
+ * Parity target: paddle/fluid/inference/capi_exp/pd_inference_api.h —
+ * the C ABI for embedding Paddle inference in C/C++/Go/R programs
+ * (Config -> Predictor -> Run with raw buffers).
+ *
+ * TPU-native implementation: the library embeds CPython and drives
+ * paddle_tpu.inference (StableHLO deserialization + XLA compile); the
+ * data plane is raw float32 buffers + int64 shape arrays across the C
+ * boundary. Link with: -lpd_inference -lpython3.x
+ *
+ * The embedded interpreter honors PYTHONPATH (must include the
+ * paddle_tpu checkout) and JAX_PLATFORMS (set "cpu" to force host
+ * execution).
+ */
+#ifndef PD_INFERENCE_API_H_
+#define PD_INFERENCE_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+/* Global runtime (Py_Initialize). Returns 0 on success. */
+int PD_Init(void);
+void PD_Finalize(void);
+
+/* Config (reference PD_ConfigCreate / PD_ConfigSetModel). `prefix` is
+ * the jit.save / save_inference_model path prefix. */
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config* cfg, const char* prefix);
+void PD_ConfigSetOptimCacheDir(PD_Config* cfg, const char* dir);
+void PD_ConfigDestroy(PD_Config* cfg);
+
+/* Predictor (reference PD_PredictorCreate / PD_PredictorRun). */
+PD_Predictor* PD_PredictorCreate(PD_Config* cfg);
+int PD_PredictorGetInputNum(PD_Predictor* pred);
+void PD_PredictorDestroy(PD_Predictor* pred);
+
+/* Run with float32 inputs; returns the first output.
+ * in_data[i]: buffer for input i; in_shapes[i]: its dims;
+ * in_ndims[i]: rank. On success (*out_data, *out_shape) are
+ * malloc'd (free with PD_Free) and *out_ndim is set. Returns 0 on
+ * success, nonzero on error (message via PD_GetLastError). */
+int PD_PredictorRunFloat(PD_Predictor* pred,
+                         const float* const* in_data,
+                         const int64_t* const* in_shapes,
+                         const int* in_ndims, int n_inputs,
+                         float** out_data, int64_t** out_shape,
+                         int* out_ndim);
+
+const char* PD_GetLastError(void);
+void PD_Free(void* p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PD_INFERENCE_API_H_ */

@@ -75,10 +75,18 @@ def _collect_layers(func, args):
     return layers
 
 
+_digest_cache = {}  # id(arr) -> (weakref, digest); bounded
+_DIGEST_CACHE_MAX = 64
+
+
 def _freeze_static(v):
     """Hashable cache-key form of a static (non-Tensor) argument.
     Arrays hash by CONTENT digest — repr() truncates big arrays and
-    would silently collide distinct values into one compiled program."""
+    would silently collide distinct values into one compiled program.
+    Digests memoize per array object (weakly) so a large static table
+    is hashed once, not on every call; in-place mutation of a static
+    arg after first use is not supported (jax's own static-arg
+    contract)."""
     try:
         hash(v)
         return v
@@ -86,10 +94,21 @@ def _freeze_static(v):
         pass
     if isinstance(v, np.ndarray):
         import hashlib
+        import weakref
 
-        return ("ndarray", v.shape, str(v.dtype),
-                hashlib.sha256(np.ascontiguousarray(v).tobytes())
-                .digest())
+        ent = _digest_cache.get(id(v))
+        if ent is not None and ent[0]() is v:
+            return ent[1]
+        key = ("ndarray", v.shape, str(v.dtype),
+               hashlib.sha256(np.ascontiguousarray(v).tobytes())
+               .digest())
+        try:
+            if len(_digest_cache) >= _DIGEST_CACHE_MAX:
+                _digest_cache.clear()
+            _digest_cache[id(v)] = (weakref.ref(v), key)
+        except TypeError:
+            pass
+        return key
     try:
         import hashlib
         import pickle

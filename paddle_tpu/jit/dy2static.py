@@ -66,16 +66,27 @@ def _truthy(p):
 # convert_while_loop)
 # ---------------------------------------------------------------------------
 
-def convert_ifelse(pred, true_fn, false_fn):
+def convert_ifelse(pred, true_fn, false_fn, names=()):
     """Tensor pred (traced) -> lax.cond over both branches; concrete
     pred -> plain Python dispatch. Branch fns take no args and return
-    the tuple of names assigned in either branch."""
+    the tuple of (liveness-filtered) names assigned in the branches."""
     p = _unwrap(pred)
     if _is_traced(p):
         def wrap_branch(fn):
             def g(_):
                 vals = fn()
-                return tuple(jnp.asarray(_unwrap(v)) for v in vals)
+                out = []
+                for i, v in enumerate(vals):
+                    if isinstance(v, _Undefined):
+                        n = names[i] if i < len(names) else f"#{i}"
+                        raise ValueError(
+                            f"dy2static: variable {n!r} is assigned in "
+                            "only one branch of a traced conditional "
+                            "but used afterwards — assign it in both "
+                            "branches (XLA cond outputs must exist on "
+                            "both paths)")
+                    out.append(jnp.asarray(_unwrap(v)))
+                return tuple(out)
 
             return g
 
@@ -117,8 +128,10 @@ def convert_while(cond_fn, body_fn, init_vals):
             tuple(jnp.asarray(_unwrap(v)) for v in init_vals))
         return tuple(_wrap(o) for o in outs)
     vals = init_vals
-    while _truthy(_unwrap(cond_fn(*vals))):
+    p = p0  # reuse the probe — the condition must not run twice
+    while _truthy(_unwrap(p)):
         vals = tuple(body_fn(*vals))
+        p = cond_fn(*vals)
     return vals
 
 
@@ -173,6 +186,16 @@ def _assigned_names(nodes):
             self._collect(node.target)
             self.generic_visit(node)
 
+        def visit_NamedExpr(self, node):  # walrus :=
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._collect(item.optional_vars)
+            self.generic_visit(node)
+
         def _collect(self, t):
             if isinstance(t, ast.Name):
                 if t.id not in names:
@@ -207,9 +230,21 @@ def _check_no_flow_escape(nodes):
         V().visit(n)
 
 
+def _loaded_names(node):
+    """All Name-Load identifiers within `node`."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, fdef=None):
         self._n = 0
+        # loads over the whole function: the liveness approximation
+        # for branch-local temporaries
+        self._fn_loads = _loaded_names(fdef) if fdef is not None else None
 
     def _fresh(self, kind):
         self._n += 1
@@ -239,10 +274,25 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return guards
 
     def visit_If(self, node):
+        # liveness BEFORE transforming children (the rewrite introduces
+        # loads of every threaded name)
+        assigned_t = set(_assigned_names(node.body))
+        assigned_f = set(_assigned_names(node.orelse))
+        inside_loads = _loaded_names(node)
         self.generic_visit(node)
         _check_no_flow_escape(node.body)
         _check_no_flow_escape(node.orelse)
         names = _assigned_names(node.body + node.orelse)
+        if self._fn_loads is not None:
+            # thread a name through lax.cond only when BOTH branches
+            # produce it, or something outside this if reads it —
+            # branch-local temporaries stay local (they'd otherwise
+            # surface UNDEF through the other branch)
+            outside_loads = self._fn_loads - (inside_loads
+                                              - _loaded_names(node.test))
+            names = [n for n in names
+                     if (n in assigned_t and n in assigned_f)
+                     or n in outside_loads]
         tname, fname = self._fresh("true"), self._fresh("false")
         # each branch takes the assigned names as DEFAULT arguments
         # bound at def time: a branch can read a name it also assigns
@@ -268,7 +318,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
                                attr="convert_ifelse", ctx=ast.Load()),
             args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
-                  ast.Name(id=fname, ctx=ast.Load())], keywords=[])
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())], keywords=[])
         if names:
             assign = ast.Assign(
                 targets=[self._names_tuple(names, ast.Store)], value=call)
@@ -351,13 +403,23 @@ def ast_transform(func):
     if not has_cf:
         return None  # nothing to do — keep the original
     try:
-        new_tree = _ControlFlowTransformer().visit(tree)
+        new_tree = _ControlFlowTransformer(fdef).visit(tree)
     except _Unsupported:
         return None
     ast.fix_missing_locations(new_tree)
     from . import dy2static as _jst_mod
 
-    glb = dict(func.__globals__)
+    class _LiveGlobals(dict):
+        """Reads fall through to the function's LIVE module globals
+        (helpers defined after the decorated function resolve);
+        writes stay local so the rebuilt defs never overwrite the
+        user's module bindings."""
+
+        def __missing__(self, k):
+            return func.__globals__[k]
+
+    glb = _LiveGlobals()
+    glb["__builtins__"] = func.__globals__.get("__builtins__", __builtins__)
     glb["_jst"] = _jst_mod
     closure = getattr(func, "__closure__", None) or ()
     freevars = func.__code__.co_freevars

@@ -115,6 +115,10 @@ class Profiler:
         _recorder.active = True
         _recorder.events = []
         self._last_step_t = time.perf_counter()
+        # host/device common epoch: device (XPlane) timestamps are
+        # relative to trace start, so host events rebase onto the same
+        # zero for ONE correlated timeline
+        self._epoch = time.perf_counter()
         if ProfilerTarget.TPU in self._targets and not self._timer_only:
             import tempfile
 
@@ -152,14 +156,46 @@ class Profiler:
         return f"avg step time: {avg * 1000:.3f} ms"
 
     def export(self, path, format="json"):
+        epoch = getattr(self, "_epoch", 0.0)
         events = [{
             "name": name, "cat": cat, "ph": "X",
-            "ts": begin * 1e6, "dur": (end - begin) * 1e6,
+            "ts": (begin - epoch) * 1e6,
+            "dur": (end - begin) * 1e6,
             "pid": 0, "tid": tid,
         } for name, cat, begin, end, tid in _recorder.events]
+        # merged host+device timeline (reference: the new profiler's
+        # EventNode trees combining HostTracer + CudaTracer into ONE
+        # chrome trace): fold the XLA/device events jax.profiler
+        # captured into the same traceEvents list, on separate pids
+        events.extend(self._device_trace_events())
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+    def _device_trace_events(self, pid_offset=1000):
+        """Chrome-trace events from the jax.profiler (XPlane) capture,
+        re-labeled onto device pids."""
+        if self._jax_dir is None:
+            return []
+        import glob
+        import gzip
+
+        out = []
+        pattern = os.path.join(self._jax_dir, "**", "*.trace.json.gz")
+        for fp in glob.glob(pattern, recursive=True):
+            try:
+                with gzip.open(fp, "rt") as f:
+                    trace = json.load(f)
+            except (OSError, ValueError):
+                continue
+            for ev in trace.get("traceEvents", []):
+                if not isinstance(ev, dict) or "ph" not in ev:
+                    continue
+                ev = dict(ev)
+                if isinstance(ev.get("pid"), int):
+                    ev["pid"] = ev["pid"] + pid_offset
+                out.append(ev)
+        return out
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
@@ -170,6 +206,20 @@ class Profiler:
         lines = [f"{'Event':40s} {'Calls':>8s} {'Total(ms)':>12s}"]
         for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"{name:40s} {cnt:8d} {tot * 1000:12.3f}")
+        # op-level dispatch stats when FLAGS_profile_ops was on
+        # (ir/cost_model op stat table analog)
+        from ..core import monitor as _mon
+
+        op_stats = {k: v for k, v in _mon.registry.all().items()
+                    if k.startswith("op/")}
+        if op_detail and op_stats:
+            lines.append("")
+            lines.append(f"{'Op':40s} {'Calls':>8s} {'Host us':>12s}")
+            ops = sorted({k.split('/')[1] for k in op_stats})
+            for op in ops:
+                calls = op_stats.get(f"op/{op}/calls", 0)
+                us = op_stats.get(f"op/{op}/host_us", 0)
+                lines.append(f"{op:40s} {calls:8d} {us:12d}")
         return "\n".join(lines)
 
     def __enter__(self):

@@ -1,0 +1,100 @@
+"""C inference API (reference: inference/capi_exp + tests in
+inference/tests/api): compile a real C program against
+pd_inference_api.h, run it as a separate process, and check its
+output matches the Python predictor."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "pd_inference_api.h"
+
+int main(int argc, char** argv) {
+  if (PD_Init() != 0) {
+    fprintf(stderr, "init failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1]);
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "predictor failed: %s\n", PD_GetLastError());
+    return 2;
+  }
+  if (PD_PredictorGetInputNum(pred) != 1) return 3;
+
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = (float)i * 0.5f;
+  int64_t shape[2] = {2, 4};
+  const float* in_ptrs[1] = {in};
+  const int64_t* shape_ptrs[1] = {shape};
+  int ndims[1] = {2};
+
+  float* out = NULL;
+  int64_t* out_shape = NULL;
+  int out_ndim = 0;
+  if (PD_PredictorRunFloat(pred, in_ptrs, shape_ptrs, ndims, 1, &out,
+                           &out_shape, &out_ndim) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 4;
+  }
+  int64_t numel = 1;
+  for (int d = 0; d < out_ndim; ++d) numel *= out_shape[d];
+  printf("ndim=%d numel=%lld\n", out_ndim, (long long)numel);
+  for (int64_t i = 0; i < numel; ++i) printf("%.6f\n", out[i]);
+  PD_Free(out);
+  PD_Free(out_shape);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}
+"""
+
+
+def test_c_program_matches_python_predictor(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.inference.capi import build_capi, header_path
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+    prefix = str(tmp_path / "m")
+    jit_save(net, prefix, input_spec=[InputSpec([2, 4], "float32")])
+
+    so = build_capi()
+    c_src = tmp_path / "main.c"
+    c_src.write_text(C_PROGRAM)
+    exe = str(tmp_path / "pd_demo")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = (sysconfig.get_config_var("LDVERSION")
+           or sysconfig.get_python_version())
+    hdr_dir = os.path.dirname(header_path())
+    subprocess.run(
+        ["gcc", str(c_src), "-o", exe, f"-I{hdr_dir}", so,
+         f"-L{libdir}", f"-lpython{ver}"],
+        check=True, capture_output=True, text=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run([exe, prefix], env=env, capture_output=True,
+                         text=True, timeout=240)
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.strip().splitlines()
+    assert lines[0] == "ndim=2 numel=6"
+    got = np.array([float(v) for v in lines[1:]]).reshape(2, 3)
+
+    x = np.arange(8, dtype=np.float32).reshape(2, 4) * 0.5
+    ref = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

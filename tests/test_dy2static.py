@@ -208,3 +208,56 @@ def test_static_arg_cache_distinguishes_array_values():
     rb = float(np.asarray(f(x, b)._value)[0])
     assert abs(ra - 10_000.0) < 1e-3
     assert abs(rb - 10_002.0) < 1e-3
+
+
+def test_branch_local_temp_variable_allowed():
+    """A scratch var assigned in only one branch and never used after
+    stays branch-local (round-2 review: UNDEF crashed lax.cond)."""
+    @to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            tmp = x * 3.0
+            y = tmp + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    xp = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xp)._value), 4.0)
+    xn = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xn)._value), -2.0)
+
+
+def test_single_branch_var_used_later_gives_clear_error():
+    @to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            z = x * 3.0
+        else:
+            y = x - 1.0  # noqa: F841
+        return z  # z undefined on the false path
+
+    xp = paddle.to_tensor(np.ones((2,), np.float32))
+    with pytest.raises(Exception, match="only one branch|z"):
+        f(xp)
+
+
+def _late_helper(x):
+    return x * 7.0
+
+
+def test_forward_referenced_global_helper():
+    """Globals defined after the decorated function resolve (live
+    globals, not a decoration-time snapshot)."""
+    @to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = _late_helper2(x)
+        else:
+            y = x
+        return y
+
+    # define AFTER decoration
+    globals()["_late_helper2"] = _late_helper
+    xp = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xp)._value), 7.0)

@@ -99,3 +99,39 @@ def test_profile_ops_flag_records_counts():
         assert mon.stat_get("op/exp/host_us") >= 0
     finally:
         paddle2.set_flags({"FLAGS_profile_ops": False})
+
+
+def test_profiler_merged_timeline_and_op_summary(tmp_path):
+    """Merged host+device chrome trace + op-level summary (reference:
+    profiler/profiler.h Profiler + ChromeTracingLogger merged
+    EventNode trees; ir/cost_model op stats)."""
+    import json
+
+    import paddle_tpu.profiler as profiler
+
+    from paddle_tpu.core import monitor as mon2
+
+    paddle.set_flags({"FLAGS_profile_ops": True})
+    try:
+        mon2.stat_reset()
+        prof = profiler.Profiler()
+        prof.start()
+        with profiler.RecordEvent("my_region"):
+            t = paddle.to_tensor(np.ones((64, 64), np.float32))
+            (t @ t).numpy()
+        prof.step()
+        prof.stop()
+        out = tmp_path / "trace.json"
+        prof.export(str(out))
+        trace = json.load(open(out))
+        names = {e.get("name") for e in trace["traceEvents"]}
+        assert "my_region" in names  # host event present
+        # device events merged when the jax trace captured any
+        pids = {e.get("pid") for e in trace["traceEvents"]
+                if isinstance(e.get("pid"), int)}
+        assert 0 in pids
+        s = prof.summary()
+        assert "my_region" in s
+        assert "matmul" in s  # op-level stats folded in
+    finally:
+        paddle.set_flags({"FLAGS_profile_ops": False})
