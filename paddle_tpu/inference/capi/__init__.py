@@ -20,7 +20,7 @@ def header_path():
 
 def build_capi(verbose=False):
     """Compile the C API shared library; returns the .so path."""
-    from ...utils.cpp_extension import get_build_directory, load
+    from ...utils.cpp_extension import load
 
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "pd_inference_api.cc")

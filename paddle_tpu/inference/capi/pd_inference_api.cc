@@ -36,13 +36,14 @@ void set_error_from_python() {
   Py_XDECREF(tb);
 }
 
+PyObject* g_inference_mod = nullptr;
+
 PyObject* inference_module() {
-  static PyObject* mod = nullptr;
-  if (mod == nullptr) {
-    mod = PyImport_ImportModule("paddle_tpu.inference");
-    if (mod == nullptr) set_error_from_python();
+  if (g_inference_mod == nullptr) {
+    g_inference_mod = PyImport_ImportModule("paddle_tpu.inference");
+    if (g_inference_mod == nullptr) set_error_from_python();
   }
-  return mod;
+  return g_inference_mod;
 }
 
 }  // namespace
@@ -74,6 +75,7 @@ int PD_Init(void) {
 }
 
 void PD_Finalize(void) {
+  g_inference_mod = nullptr;  // owned by the dying interpreter
   if (Py_IsInitialized()) Py_Finalize();
 }
 

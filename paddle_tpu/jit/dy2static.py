@@ -239,12 +239,27 @@ def _loaded_names(node):
     return out
 
 
+def _loads_excluding(root, excluded):
+    """Name-Load identifiers in `root` EXCLUDING the `excluded`
+    subtree (its test still counts — it executes outside the
+    branches)."""
+    out = set()
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if n is excluded:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return out | _loaded_names(excluded.test)
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self, fdef=None):
         self._n = 0
-        # loads over the whole function: the liveness approximation
-        # for branch-local temporaries
-        self._fn_loads = _loaded_names(fdef) if fdef is not None else None
+        # root kept for per-If "loads outside this if" liveness
+        self._root = fdef
 
     def _fresh(self, kind):
         self._n += 1
@@ -278,18 +293,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # loads of every threaded name)
         assigned_t = set(_assigned_names(node.body))
         assigned_f = set(_assigned_names(node.orelse))
-        inside_loads = _loaded_names(node)
+        outside_loads = (_loads_excluding(self._root, node)
+                         if self._root is not None else None)
         self.generic_visit(node)
         _check_no_flow_escape(node.body)
         _check_no_flow_escape(node.orelse)
         names = _assigned_names(node.body + node.orelse)
-        if self._fn_loads is not None:
+        if outside_loads is not None:
             # thread a name through lax.cond only when BOTH branches
-            # produce it, or something outside this if reads it —
+            # produce it, or a load OUTSIDE this if reads it —
             # branch-local temporaries stay local (they'd otherwise
             # surface UNDEF through the other branch)
-            outside_loads = self._fn_loads - (inside_loads
-                                              - _loaded_names(node.test))
             names = [n for n in names
                      if (n in assigned_t and n in assigned_f)
                      or n in outside_loads]

@@ -43,6 +43,25 @@ def main(out_prefix):
         json.dump(losses, f)
     print(f"rank {get_rank()} losses {losses}", flush=True)
 
+    # eager cross-process collectives (multihost_utils path): each rank
+    # contributes rank+1; the all_reduce must return the WORLD sum on
+    # every rank (r1 weak #10: the single-controller identity would be
+    # silently wrong multi-process)
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        from paddle_tpu.distributed import all_reduce, broadcast
+
+        t = paddle.to_tensor(
+            np.array([float(get_rank() + 1)], np.float32))
+        all_reduce(t)
+        b = paddle.to_tensor(
+            np.array([float(get_rank() * 100)], np.float32))
+        broadcast(b, src=0)
+        with open(f"{out_prefix}.coll{get_rank()}", "w") as f:
+            json.dump({"allreduce": float(t.numpy()[0]),
+                       "broadcast": float(b.numpy()[0])}, f)
+
 
 if __name__ == "__main__":
     main(sys.argv[1])

@@ -261,3 +261,22 @@ def test_forward_referenced_global_helper():
     globals()["_late_helper2"] = _late_helper
     xp = paddle.to_tensor(np.ones((2,), np.float32))
     np.testing.assert_allclose(np.asarray(f(xp)._value), 7.0)
+
+
+def test_branch_var_loaded_inside_and_after():
+    """A name read both inside a branch AND after the if must still be
+    threaded out (round-2 review: set subtraction dropped it)."""
+    @to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            t = x * 3.0
+            y = t + 1.0
+        else:
+            t = x * 0.0
+            y = x - 1.0
+        return y + t
+
+    xp = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xp)._value), 7.0)  # 4 + 3
+    xn = paddle.to_tensor(-np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(f(xn)._value), -2.0)

@@ -74,6 +74,12 @@ def test_two_process_dp_matches_single(tmp_path):
     np.testing.assert_allclose(two[0], two[1], rtol=0, atol=0)
     np.testing.assert_allclose(two[0], single, rtol=1e-5, atol=1e-5)
     assert two[0][-1] < two[0][0]
+    # eager cross-process collectives: sum of rank+1 over 2 procs = 3;
+    # broadcast carries rank 0's value to rank 1
+    for r in range(2):
+        coll = json.load(open(f"{tmp_path / 'two'}.coll{r}"))
+        assert coll["allreduce"] == 3.0
+        assert coll["broadcast"] == 0.0
 
 
 def test_launch_cli(tmp_path):
