@@ -19,6 +19,7 @@ from .spawn import spawn
 from . import sharding
 from . import auto_parallel
 from . import ps
+from . import fleet_executor
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op, reshard
 
 

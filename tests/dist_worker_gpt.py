@@ -47,9 +47,7 @@ def main(out_prefix):
     # contributes rank+1; the all_reduce must return the WORLD sum on
     # every rank (r1 weak #10: the single-controller identity would be
     # silently wrong multi-process)
-    import jax as _jax
-
-    if _jax.process_count() > 1:
+    if jax.process_count() > 1:
         from paddle_tpu.distributed import all_reduce, broadcast
 
         t = paddle.to_tensor(

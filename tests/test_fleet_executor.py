@@ -1,0 +1,142 @@
+"""FleetExecutor actor dataflow (reference:
+fleet_executor/carrier.h:49, compute_interceptor.cc — TaskNode graph
+run by credit-passing interceptors)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet_executor import (Carrier, FleetExecutor,
+                                                   TaskNode)
+
+
+def test_linear_pipeline_preserves_order():
+    a = TaskNode(lambda x: x + 1, name="inc")
+    b = TaskNode(lambda x: x * 2, name="dbl")
+    c = TaskNode(lambda x: x - 3, name="dec")
+    a.add_downstream_task(b)
+    b.add_downstream_task(c)
+    out = FleetExecutor([a, b, c]).run(range(6))
+    assert out == [(i + 1) * 2 - 3 for i in range(6)]
+
+
+def test_pipeline_overlaps_stages():
+    """With credit-based actors, total wall time ~ sum of the slowest
+    stage, not the sum of all stages (micro-batch overlap)."""
+    def slow(tag, dt):
+        def fn(x):
+            time.sleep(dt)
+            return x
+
+        fn.__name__ = tag
+        return fn
+
+    s1 = TaskNode(slow("s1", 0.05), name="s1")
+    s2 = TaskNode(slow("s2", 0.05), name="s2")
+    s1.add_downstream_task(s2)
+    n = 8
+    t0 = time.perf_counter()
+    out = FleetExecutor([s1, s2]).run(range(n))
+    dt = time.perf_counter() - t0
+    assert len(out) == n
+    serial = n * 2 * 0.05
+    assert dt < serial * 0.8, f"no overlap: {dt:.3f}s vs serial {serial:.3f}s"
+
+
+def test_fan_in_join():
+    """A node with two upstreams joins one message from each."""
+    src = TaskNode(lambda x: x, name="src")
+    left = TaskNode(lambda x: x * 10, name="left")
+    right = TaskNode(lambda x: x + 1, name="right")
+    join = TaskNode(lambda a, b: a + b, name="join")
+    src.add_downstream_task(left)
+    src.add_downstream_task(right)
+    left.add_downstream_task(join)
+    right.add_downstream_task(join)
+    out = FleetExecutor([src, left, right, join]).run(range(4))
+    assert out == [i * 10 + i + 1 for i in range(4)]
+
+
+def test_task_error_propagates():
+    def boom(x):
+        if x == 2:
+            raise ValueError("boom")
+        return x
+
+    a = TaskNode(boom, name="a")
+    b = TaskNode(lambda x: x, name="b")
+    a.add_downstream_task(b)
+    carrier = Carrier([a, b]).start()
+    for i in range(4):
+        carrier.feed("a", i)
+    carrier.stop_feeds()
+    with pytest.raises(RuntimeError, match="boom"):
+        list(carrier.collect("b"))
+
+
+def test_train_step_dataflow():
+    """Realistic host pipeline: augment -> compiled train step."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    step = TrainStepCompiler(
+        net, optim.SGD(learning_rate=0.1, parameters=net.parameters()),
+        lambda o, y: ((o - y) ** 2).mean())
+    rng = np.random.RandomState(0)
+
+    def augment(i):
+        x = rng.randn(4, 8).astype(np.float32)
+        return x, x * 0.5
+
+    def train(batch):
+        x, y = batch
+        return float(step(x, y).item())
+
+    aug = TaskNode(augment, name="augment")
+    trn = TaskNode(train, name="train")
+    aug.add_downstream_task(trn)
+    losses = FleetExecutor([aug, trn]).run(range(10))
+    assert len(losses) == 10
+    assert losses[-1] < losses[0]
+
+
+def test_error_with_backpressure_does_not_deadlock():
+    """Failure deep in the pipeline with MANY queued feeds must drain
+    and raise, not wedge the feed loop (round-2 review)."""
+    a = TaskNode(lambda x: x, name="a", buffer_size=2)
+
+    def boom(x):
+        raise ValueError("early boom")
+
+    b = TaskNode(boom, name="b", buffer_size=2)
+    a.add_downstream_task(b)
+    with pytest.raises(RuntimeError, match="early boom"):
+        FleetExecutor([a, b]).run(range(50))
+
+
+def test_duplicate_names_rejected():
+    a = TaskNode(lambda x: x + 1)
+    b = TaskNode(lambda x: x * 2)
+    a.add_downstream_task(b)
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetExecutor([a, b]).run(range(2))
+
+
+def test_uneven_fan_in_terminates():
+    """One upstream ending early (max_run_times) ends the join without
+    blocking the longer producer."""
+    src = TaskNode(lambda x: x, name="src")
+    short = TaskNode(lambda x: x, name="short", max_run_times=2,
+                     buffer_size=2)
+    long_ = TaskNode(lambda x: x, name="long", buffer_size=2)
+    join = TaskNode(lambda a, b: a + b, name="join", buffer_size=2)
+    src.add_downstream_task(short)
+    src.add_downstream_task(long_)
+    short.add_downstream_task(join)
+    long_.add_downstream_task(join)
+    out = FleetExecutor([src, short, long_, join]).run(range(12))
+    assert out == [0, 2]  # two joined pairs, then clean termination
