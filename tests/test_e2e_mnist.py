@@ -65,3 +65,42 @@ def test_hapi_model_fit():
                   lambda logits, y: loss_fn(logits, paddle.squeeze(y, -1)))
     ds = MNIST(mode="train")
     model.fit(ds, batch_size=64, epochs=1, verbose=0, num_iters=10)
+
+
+def test_mnist_static_graph_e2e():
+    """BASELINE config 1, static-graph variant: LeNet on synthetic
+    MNIST through Program/Executor (reference: the static train loop
+    in the MNIST tutorials over fluid.Program)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [None, 1, 28, 28], "float32")
+            label = static.data("label", [None, 1], "int64")
+            net = LeNet()
+            logits = net(img)
+            loss = paddle.nn.functional.cross_entropy(
+                logits, paddle.squeeze(label, -1))
+            opt = paddle.optimizer.Adam(learning_rate=1e-3)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        ds = MNIST(mode="train")
+        xs = np.stack([np.asarray(ds[i][0]._value
+                                  if hasattr(ds[i][0], "_value")
+                                  else ds[i][0]) for i in range(64)])
+        ys = np.stack([np.asarray(ds[i][1]) for i in range(64)]
+                      ).reshape(64, 1).astype(np.int64)
+        losses = []
+        for _ in range(6):
+            l, = exe.run(main, feed={"img": xs.astype(np.float32),
+                                     "label": ys},
+                         fetch_list=[loss])
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+    finally:
+        paddle.disable_static()
