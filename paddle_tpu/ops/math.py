@@ -332,6 +332,19 @@ def cumsum(x, axis=None, dtype=None, name=None):
                     dtype=convert_dtype(dtype))
 
 
+def _k_diff(x, prepend, append, n, axis):
+    parts = [p for p in (prepend, x, append) if p is not None]
+    v = jnp.concatenate(parts, axis=axis) if len(parts) > 1 else x
+    return jnp.diff(v, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """reference: paddle.diff (tensor/math.py) — n-th forward
+    difference along axis, with optional prepend/append edges."""
+    return apply_op("diff", _k_diff, x, prepend, append, n=int(n),
+                    axis=int(axis))
+
+
 def _k_cumprod(x, dim, dtype):
     out = jnp.cumprod(x.reshape(-1) if dim is None else x,
                       axis=0 if dim is None else dim)
@@ -481,3 +494,4 @@ _export("quantile", quantile)
 _export("nanquantile", nanquantile)
 _export("numel", numel)
 _export("broadcast_shape", broadcast_shape)
+_export("diff", diff)

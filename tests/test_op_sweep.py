@@ -356,3 +356,78 @@ def test_cumulative_ops():
     vals, idx = paddle.cummax(t, axis=1)
     np.testing.assert_allclose(np.asarray(vals._value),
                                np.maximum.accumulate(X34, axis=1))
+
+
+def test_indexing_and_padding_ops():
+    t = paddle.to_tensor(X34)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.gather(t, paddle.to_tensor(
+            np.array([2, 0], np.int64)), axis=0)._value), X34[[2, 0]])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.index_select(t, paddle.to_tensor(
+            np.array([1, 3], np.int64)), axis=1)._value), X34[:, [1, 3]])
+    oh = paddle.nn.functional.one_hot(
+        paddle.to_tensor(np.array([0, 2], np.int64)), 4)
+    np.testing.assert_array_equal(np.asarray(oh._value),
+                                  np.eye(4, dtype=np.float32)[[0, 2]])
+    padded = paddle.nn.functional.pad(t, [1, 1, 0, 0])
+    # paddle pads FIRST-dim-first: [1,1,0,0] on (3,4) -> (5,4)
+    assert list(padded.shape) == [5, 4]
+    np.testing.assert_array_equal(
+        np.asarray(paddle.broadcast_to(
+            paddle.to_tensor(np.ones((1, 4), np.float32)),
+            [3, 4])._value), np.ones((3, 4)))
+
+
+def test_set_ops_and_uniques():
+    v = paddle.to_tensor(np.array([3, 1, 3, 2, 1], np.int64))
+    u = paddle.unique(v)
+    got = np.sort(np.asarray((u[0] if isinstance(u, (tuple, list))
+                              else u)._value))
+    np.testing.assert_array_equal(got, [1, 2, 3])
+    b = paddle.bincount(paddle.to_tensor(
+        np.array([0, 1, 1, 3], np.int64)))
+    np.testing.assert_array_equal(np.asarray(b._value), [1, 2, 0, 1])
+
+
+def test_linalg_extras():
+    a = RNG.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        float(paddle.trace(paddle.to_tensor(a)).item()),
+        np.trace(a), rtol=1e-5)
+    v1 = np.array([1.0, 0.0, 0.0], np.float32)
+    v2 = np.array([0.0, 1.0, 0.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cross(paddle.to_tensor(v1),
+                                paddle.to_tensor(v2))._value),
+        np.cross(v1, v2), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.kron(paddle.to_tensor(np.eye(
+            2, dtype=np.float32)), paddle.to_tensor(
+                np.ones((2, 2), np.float32)))._value),
+        np.kron(np.eye(2), np.ones((2, 2))), atol=1e-6)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = np.asarray(paddle.linalg.cholesky(
+        paddle.to_tensor(spd))._value)
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+
+
+def test_stat_and_misc_ops():
+    t = paddle.to_tensor(X34)
+    np.testing.assert_allclose(
+        float(paddle.median(paddle.to_tensor(
+            np.array([3.0, 1.0, 2.0], np.float32))).item()), 2.0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.quantile(t, 0.5)._value),
+        np.quantile(X34, 0.5), rtol=1e-5)
+    k = paddle.kthvalue(paddle.to_tensor(
+        np.array([5.0, 1.0, 3.0], np.float32)), 2)
+    vals = k[0] if isinstance(k, (tuple, list)) else k
+    assert abs(float(np.asarray(vals._value)) - 3.0) < 1e-6
+    np.testing.assert_allclose(
+        np.asarray(paddle.diff(paddle.to_tensor(
+            np.array([1.0, 4.0, 9.0], np.float32)))._value),
+        [3.0, 5.0], atol=1e-6)
+    mg = paddle.meshgrid(paddle.to_tensor(np.arange(2.0)),
+                         paddle.to_tensor(np.arange(3.0)))
+    assert list(mg[0].shape) == [2, 3]
