@@ -90,22 +90,39 @@ def _require_world_group(group, opname):
             "mesh axis, or use the world group")
 
 
-def _reduce_op_fn(op):
-    return {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
-            ReduceOp.MIN: lax.pmin}.get(op, lax.psum)
+def _reduce_in_trace(v, op, axes):
+    """Reduce `v` across every bound mesh axis of the group.
+
+    SUM/MAX/MIN/AVG ride the native XLA collectives (which accept a
+    tuple of axis names). PROD has no XLA reduction primitive —
+    c_allreduce_prod parity (collective/c_allreduce_op.h:393) is an
+    all_gather per axis followed by a product over the gathered dim,
+    which XLA still fuses into one pass over ICI. Unknown op codes
+    raise instead of silently summing."""
+    if op == ReduceOp.PROD:
+        out = v
+        for a in axes:
+            out = jnp.prod(lax.all_gather(out, a, axis=0), axis=0)
+        return out
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum(v, axes)
+        if op == ReduceOp.AVG:
+            out = out / np.prod([lax.psum(1, a) for a in axes])
+        return out
+    if op == ReduceOp.MAX:
+        return lax.pmax(v, axes)
+    if op == ReduceOp.MIN:
+        return lax.pmin(v, axes)
+    raise ValueError(
+        f"paddle.distributed.all_reduce: unsupported ReduceOp {op!r}")
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_* analog (collective/c_allreduce_op.h:359)."""
     axes = _axis_names(group)
     if _in_collective_trace(axes):
-        fn = _reduce_op_fn(op)
-
         def _k(v):
-            out = fn(v, axes)
-            if op == ReduceOp.AVG:
-                out = out / np.prod([lax.psum(1, a) for a in axes])
-            return out
+            return _reduce_in_trace(v, op, axes)
 
         out = apply_op("c_allreduce", _k, tensor)
         tensor._value = out._value
@@ -121,12 +138,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         from jax.experimental import multihost_utils as mhu
 
         _require_world_group(group, "all_reduce")
+        reds = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+                ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+                ReduceOp.AVG: jnp.mean}
+        if op not in reds:
+            raise ValueError(
+                f"paddle.distributed.all_reduce: unsupported ReduceOp "
+                f"{op!r}")
         gathered = mhu.process_allgather(
             tensor._value if isinstance(tensor, Tensor) else tensor)
-        red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
-               ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
-               ReduceOp.AVG: jnp.mean}.get(op, jnp.sum)
-        result = red(gathered, axis=0)
+        result = reds[op](gathered, axis=0)
         if isinstance(tensor, Tensor):
             tensor._value = result
             return tensor
@@ -135,14 +156,44 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+def _gather_all_axes(v, axes):
+    """all_gather across every bound axis, flattened to one leading dim
+    of length prod(axis sizes), ordered row-major by mesh axis order —
+    i.e. index == the group-local rank the topology assigns. Gathering
+    only axes[0] for a multi-axis (world) group would silently collect
+    a fraction of the shards (ADVICE r2)."""
+    g = v
+    for a in reversed(axes):
+        g = lax.all_gather(g, a, axis=0)
+    if len(axes) > 1:
+        g = g.reshape((-1,) + v.shape)
+    return g
+
+
+def _flat_rank(axes):
+    """Group-local rank, row-major by mesh axis order (same ordering as
+    _gather_all_axes' leading dim)."""
+    r = None
+    for a in axes:
+        idx = lax.axis_index(a)
+        r = idx if r is None else r * lax.psum(1, a) + idx
+    return r
+
+
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast analog — single-controller: value is already
-    replicated; in shard_map trace, select src's value."""
+    replicated; in shard_map trace, select src's value via a masked
+    psum: O(1) extra memory per rank, vs a full world-size all_gather
+    that materializes prod(axis sizes)x the tensor just to index one
+    shard."""
     axes = _axis_names(group)
     if _in_collective_trace(axes):
         def _k(v):
-            src_val = lax.all_gather(v, axes[0], axis=0)[src]
-            return src_val
+            contrib = jnp.where(_flat_rank(axes) == src, v,
+                                jnp.zeros_like(v))
+            if v.dtype == jnp.bool_:
+                return lax.psum(contrib.astype(jnp.int32), axes) != 0
+            return lax.psum(contrib, axes)
 
         out = apply_op("c_broadcast", _k, tensor)
         tensor._value = out._value
@@ -173,7 +224,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     axes = _axis_names(group)
     if _in_collective_trace(axes):
         def _k(v):
-            return lax.all_gather(v, axes[0], axis=0)
+            return _gather_all_axes(v, axes)
 
         out = apply_op("c_allgather", _k, tensor)
         n = out.shape[0]
@@ -211,6 +262,13 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         # tensor-mode alltoall: split along dim0 across group
         x = in_tensor_list
         if _in_collective_trace(axes):
+            if len(axes) > 1:
+                raise NotImplementedError(
+                    "paddle.distributed.alltoall: group spans multiple "
+                    f"mesh axes {axes} — alltoall over a flattened "
+                    "multi-axis group is not supported; use a single-axis "
+                    "group (e.g. the 'ep' axis)")
+
             def _k(v):
                 n = lax.psum(1, axes[0])
                 vs = v.reshape((n, v.shape[0] // n) + v.shape[1:])
@@ -280,6 +338,11 @@ def send(tensor, dst=0, group=None, sync_op=True):
     loop would otherwise compute garbage; VERDICT round-1 weak #3)."""
     axes = _axis_names(group)
     if _in_collective_trace(axes):
+        if len(axes) > 1:
+            raise NotImplementedError(
+                "paddle.distributed.send: p2p over a multi-axis group "
+                f"{axes} is not supported — pass a single-axis group "
+                "(e.g. the 'pp' axis)")
         ax = axes[0]
         if ax in _pending_sends:
             if _entry_is_current(_pending_sends[ax][2], ax):
@@ -305,6 +368,10 @@ def recv(tensor, src=0, group=None, sync_op=True):
     ranks outside the (src, dst) edge see zeros."""
     axes = _axis_names(group)
     if _in_collective_trace(axes):
+        if len(axes) > 1:
+            raise NotImplementedError(
+                "paddle.distributed.recv: p2p over a multi-axis group "
+                f"{axes} is not supported — pass a single-axis group")
         ax = axes[0]
         if ax not in _pending_sends:
             raise RuntimeError(

@@ -16,12 +16,12 @@ step, applied here in the same precedence order:
   pipeline_optimizer     -> GPTConfig pp_num_stages/pp_schedule (model
                             configs own stage cutting; validated here)
   lamb/lars_optimizer    -> optimizer class swap (same hyperparams)
-  localsgd/dgc           -> intentionally NOT applied: approximate-
+  localsgd/dgc           -> raise NotImplementedError: approximate-
                             gradient comm optimizations exist to cut
                             NCCL bandwidth; ICI allreduce is cheap and
                             exact, so applying them would only hurt
-                            convergence (explicit design decision, not
-                            an omission).
+                            convergence (explicit design refusal — the
+                            flag errors instead of silently lying).
 """
 from __future__ import annotations
 
@@ -59,6 +59,19 @@ def apply_strategy(model, optimizer, strategy):
 
     compiler_kwargs = {}
 
+    # approximate-gradient comm optimizers are a DESIGN refusal, not a
+    # silent no-op (round-1 rule: dead API raises). DGC/LocalSGD exist
+    # to cut NCCL bandwidth at a convergence cost; ICI allreduce inside
+    # the compiled step is cheap and exact, so they are not implemented.
+    for knob in ("dgc", "localsgd", "adaptive_localsgd"):
+        if getattr(strategy, knob, False):
+            raise NotImplementedError(
+                f"DistributedStrategy.{knob}: approximate-gradient "
+                "communication optimizers are intentionally unsupported "
+                "on TPU — in-step allreduce over ICI is exact and "
+                "bandwidth-cheap, so gradient compression/periodic sync "
+                f"would only hurt convergence. Set strategy.{knob}=False.")
+
     # 1. AMP (reference amp_optimizer — outermost wrapper)
     if strategy.amp:
         cfg = strategy.amp_configs or {}
@@ -67,6 +80,12 @@ def apply_strategy(model, optimizer, strategy):
             "use_pure_bf16") else "O1"
         if level == "O2":
             model = amp_mod.decorate(model, level="O2", dtype=dtype)
+        else:
+            # O1: allow-listed ops cast inside the compiled step via
+            # auto_cast (reference decorator.py cast insertion) —
+            # previously a silent fp32 no-op (ADVICE r2)
+            compiler_kwargs["amp_level"] = "O1"
+            compiler_kwargs["amp_dtype"] = dtype
         if hasattr(optimizer, "_multi_precision"):
             optimizer._multi_precision = True
 
@@ -77,14 +96,18 @@ def apply_strategy(model, optimizer, strategy):
                                                     "remat"):
                 layer.config.remat = True
 
-    # 3. sharding / ZeRO (reference sharding_optimizer)
+    # 3. sharding / ZeRO (reference sharding_optimizer). Pass offload
+    # through so group_sharded_parallel's honesty check fires on the
+    # strategy path too (it raises — host offload is unimplemented).
     if strategy.sharding:
         from ..sharding import group_sharded_parallel
 
-        stage = int((strategy.sharding_configs or {}).get("stage", 1))
+        cfg = strategy.sharding_configs or {}
+        stage = int(cfg.get("stage", 1))
         level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os_g")
-        model, optimizer, _ = group_sharded_parallel(model, optimizer,
-                                                     level=level)
+        model, optimizer, _ = group_sharded_parallel(
+            model, optimizer, level=level,
+            offload=bool(cfg.get("offload", False)))
 
     # 4. gradient merge (reference gradient_merge_optimizer)
     if strategy.gradient_merge:

@@ -502,16 +502,23 @@ class TrainStepCompiler:
     """
 
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
-                 accumulate_steps=1):
+                 accumulate_steps=1, amp_level=None, amp_dtype="bfloat16"):
         """accumulate_steps > 1 enables gradient merge (reference:
         fleet gradient_merge_optimizer / RecomputeOptimizer micro-batch
         accumulation): grads from k consecutive calls accumulate in a
         donated buffer sharded like the parameter, and the optimizer
-        applies the averaged gradient on every k-th call."""
+        applies the averaged gradient on every k-th call.
+
+        amp_level="O1" wraps the traced forward in amp.auto_cast so
+        allow-listed ops run in `amp_dtype` (reference amp_optimizer O1
+        cast insertion, contrib/mixed_precision/decorator.py); "O2" is
+        handled outside via amp.decorate on the model."""
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
         self._donate = donate
+        self._amp_level = amp_level
+        self._amp_dtype = amp_dtype
         self._accum_steps = max(1, int(accumulate_steps))
         self._accum_state = None
         self._compiled = None
@@ -582,8 +589,19 @@ class TrainStepCompiler:
         b_items = list(bufs.items())
         self._init_opt_state(t_items)
 
+        import contextlib
+
+        if self._amp_level == "O1":
+            from .. import amp as _amp_mod
+
+            def _amp_ctx():
+                return _amp_mod.auto_cast(enable=True, level="O1",
+                                          dtype=self._amp_dtype)
+        else:
+            _amp_ctx = contextlib.nullcontext
+
         def loss_of(pvals, fvals, bvals, avals, rngc):
-            with engine.trace_mode():
+            with engine.trace_mode(), _amp_ctx():
                 prev_key = _random.push_traced_key(
                     jax.random.fold_in(_random._rng.base, rngc))
                 saved = []

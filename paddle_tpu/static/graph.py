@@ -297,6 +297,16 @@ def _record_subblock(prog, fn, args=()):
     return blk, out_flat, out_tree
 
 
+def _passthrough_outputs(blk, out_flat):
+    """Output leaves that are Variables NOT produced inside `blk` —
+    i.e. outer Variables returned untouched by the branch/body. They
+    must travel as operands so replay resolves them from env rather
+    than from their (valueless) aval."""
+    produced = blk.produced_ids()
+    return [o for o in out_flat
+            if _is_var(o) and id(o) not in produced]
+
+
 def _branch_replayer(blk, out_flat, ext_leaves):
     def run(ext_vals, seed_env=None):
         env = dict(seed_env or {})
@@ -334,9 +344,16 @@ def cond(pred, true_fn, false_fn, name=None):
             raise ValueError(f"cond: branch output shapes differ "
                              f"{sa} vs {sb}")
 
-    # externals of both branches, deduped, order-stable
+    # externals of both branches, deduped, order-stable. Pass-through
+    # outputs — branch returns an outer Variable no recorded op consumed
+    # (legit reference pattern: cond(p, lambda: x, lambda: y)) — are
+    # invisible to external_inputs(), so without them the replayer would
+    # fall back to the Variable's aval (ADVICE r2). Append them as
+    # operands so they resolve from env.
     ext, seen = [], set()
-    for leaf in tb.external_inputs() + fb.external_inputs():
+    for leaf in (tb.external_inputs() + fb.external_inputs()
+                 + _passthrough_outputs(tb, t_out)
+                 + _passthrough_outputs(fb, f_out)):
         if id(leaf) not in seen:
             seen.add(id(leaf))
             ext.append(leaf)
@@ -379,7 +396,9 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
 
     loop_ids = {id(v) for v in lv}
     ext, seen = [], set(loop_ids)
-    for leaf in cb.external_inputs() + bb.external_inputs():
+    for leaf in (cb.external_inputs() + bb.external_inputs()
+                 + _passthrough_outputs(cb, c_out)
+                 + _passthrough_outputs(bb, b_out)):
         if id(leaf) not in seen:
             seen.add(id(leaf))
             ext.append(leaf)

@@ -1,0 +1,313 @@
+"""Round-3 ADVICE/VERDICT fixes:
+- in-trace all_reduce PROD computes a product (was silently SUM)
+- unknown ReduceOp raises in the trace path
+- multi-axis (world) group broadcast/all_gather cover ALL bound axes
+- static cond/while pass-through branch outputs resolve (ADVICE r2 #2)
+- honesty: strategy.dgc/localsgd raise; sharding offload=True raises
+- strategy.amp O1 wires auto_cast into the compiled step
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import build_mesh, set_mesh
+from paddle_tpu.distributed.collective import ReduceOp, _reduce_in_trace
+from paddle_tpu.distributed.mesh import new_group_for_axes
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    set_mesh(None)
+
+
+def test_all_reduce_prod_in_trace():
+    mesh = build_mesh({"x": 8})
+    set_mesh(mesh)
+    x = (np.arange(8, dtype=np.float32) + 1.0).reshape(8, 1)
+
+    def body(xs):
+        return _reduce_in_trace(xs, ReduceOp.PROD, ("x",))
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                  out_specs=P("x"))(jnp.asarray(x))
+    # every rank holds prod(1..8) = 40320
+    np.testing.assert_allclose(np.asarray(y).ravel(),
+                               np.full(8, 40320.0))
+
+
+def test_all_reduce_prod_multi_axis_in_trace():
+    mesh = build_mesh({"a": 2, "b": 4})
+    set_mesh(mesh)
+    x = (np.arange(8, dtype=np.float32) + 1.0).reshape(2, 4)
+
+    def body(xs):
+        return _reduce_in_trace(xs, ReduceOp.PROD, ("a", "b"))
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("a", "b"),),
+                  out_specs=P("a", "b"))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.full((2, 4), 40320.0))
+
+
+def test_all_reduce_unknown_op_raises_in_trace():
+    mesh = build_mesh({"x": 8})
+    set_mesh(mesh)
+
+    def body(xs):
+        return _reduce_in_trace(xs, 99, ("x",))
+
+    with pytest.raises(ValueError, match="unsupported ReduceOp"):
+        shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                  out_specs=P("x"))(jnp.ones((8, 1), np.float32))
+
+
+def test_world_group_broadcast_multi_axis_in_trace():
+    """World group over a dp×mp mesh binds BOTH axes — broadcast must
+    select the src across the flattened 8 ranks, not just axis 0
+    (ADVICE r2 #5)."""
+    from paddle_tpu.distributed.collective import _gather_all_axes
+
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    set_mesh(mesh)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+
+    def body(xs):
+        g = _gather_all_axes(xs, ("dp", "mp"))
+        return g[5] * jnp.ones_like(xs)  # src = global rank 5
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("dp", "mp"),),
+                  out_specs=P("dp", "mp"))(jnp.asarray(x))
+    # rank 5 = coords (dp=1, mp=1) holds value 5.0
+    np.testing.assert_allclose(np.asarray(y), np.full((2, 4, 1), 5.0))
+
+
+def test_broadcast_masked_psum_multi_axis_in_trace():
+    """broadcast through the public API over a 2-axis world group:
+    masked-psum select of global rank src, O(1) extra memory."""
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    set_mesh(mesh)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+
+    def body(xs):
+        t = paddle.Tensor(xs, _internal=True)
+        return dist.broadcast(t, src=5)._value
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("dp", "mp"),),
+                  out_specs=P("dp", "mp"))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.full((2, 4, 1), 5.0))
+
+
+def test_world_group_allgather_multi_axis_in_trace():
+    from paddle_tpu.distributed.collective import _gather_all_axes
+
+    mesh = build_mesh({"dp": 2, "mp": 4})
+    set_mesh(mesh)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+
+    def body(xs):
+        return _gather_all_axes(xs, ("dp", "mp"))[None]
+
+    y = shard_map(body, mesh=mesh, in_specs=(P("dp", "mp"),),
+                  out_specs=P("dp", "mp", None, None))(jnp.asarray(x))
+    # every rank gathered all 8 shards in rank order
+    flat = np.asarray(y).reshape(2, 4, 8)
+    for i in range(2):
+        for j in range(4):
+            np.testing.assert_allclose(flat[i, j], np.arange(8.0))
+
+
+def test_alltoall_multi_axis_group_raises():
+    mesh = build_mesh({"a": 2, "b": 4})
+    set_mesh(mesh)
+    g = new_group_for_axes(("a", "b"))
+    x = np.zeros((2, 4, 8), np.float32)
+
+    def body(xs):
+        return dist.alltoall(paddle.Tensor(xs, _internal=True),
+                             group=g)._value
+
+    with pytest.raises(NotImplementedError, match="multiple"):
+        shard_map(body, mesh=mesh, in_specs=(P("a", "b"),),
+                  out_specs=P("a", "b"))(jnp.asarray(x))
+
+
+# -- static control-flow pass-through (ADVICE r2 #2) ------------------------
+
+def test_static_cond_passthrough_branches():
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            p = static.data("p", shape=[], dtype="bool")
+            x = static.data("x", shape=[2], dtype="float32")
+            y = static.data("y", shape=[2], dtype="float32")
+            out = static.nn.cond(p, lambda: x, lambda: y)
+        exe = static.Executor()
+        r_true = exe.run(prog, feed={
+            "p": np.asarray(True),
+            "x": np.asarray([1.0, 2.0], np.float32),
+            "y": np.asarray([3.0, 4.0], np.float32)},
+            fetch_list=[out])[0]
+        r_false = exe.run(prog, feed={
+            "p": np.asarray(False),
+            "x": np.asarray([1.0, 2.0], np.float32),
+            "y": np.asarray([3.0, 4.0], np.float32)},
+            fetch_list=[out])[0]
+        np.testing.assert_allclose(r_true, [1.0, 2.0])
+        np.testing.assert_allclose(r_false, [3.0, 4.0])
+    finally:
+        paddle.disable_static()
+
+
+def test_static_cond_mixed_passthrough_and_computed():
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            p = static.data("p", shape=[], dtype="bool")
+            x = static.data("x", shape=[2], dtype="float32")
+            out = static.nn.cond(p, lambda: x * 2.0, lambda: x)
+        exe = static.Executor()
+        r = exe.run(prog, feed={"p": np.asarray(False),
+                                "x": np.asarray([1.0, 2.0], np.float32)},
+                    fetch_list=[out])[0]
+        np.testing.assert_allclose(r, [1.0, 2.0])
+    finally:
+        paddle.disable_static()
+
+
+def test_static_while_passthrough_body_output():
+    """body returns an untouched outer Variable for one carry slot."""
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            i = static.data("i", shape=[], dtype="int32")
+            cap = static.data("cap", shape=[], dtype="int32")
+            acc = static.data("acc", shape=[], dtype="float32")
+            ext = static.data("ext", shape=[], dtype="float32")
+
+            def cond_fn(i_, a_):
+                return i_ < cap
+
+            def body_fn(i_, a_):
+                return i_ + 1, ext  # pass-through outer var as output
+
+            oi, oa = static.nn.while_loop(cond_fn, body_fn, [i, acc])
+        exe = static.Executor()
+        ri, ra = exe.run(prog, feed={
+            "i": np.asarray(0, np.int32), "cap": np.asarray(3, np.int32),
+            "acc": np.asarray(0.0, np.float32),
+            "ext": np.asarray(7.0, np.float32)},
+            fetch_list=[oi, oa])
+        assert int(ri) == 3
+        assert float(ra) == 7.0
+    finally:
+        paddle.disable_static()
+
+
+# -- honesty: knobs raise instead of lying ----------------------------------
+
+def test_strategy_dgc_localsgd_raise():
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_optimizer_factory import (
+        apply_strategy)
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    model = nn.Linear(4, 4)
+    opt = optim.SGD(learning_rate=0.1, parameters=model.parameters())
+    for knob in ("dgc", "localsgd", "adaptive_localsgd"):
+        strategy = fleet.DistributedStrategy()
+        setattr(strategy, knob, True)
+        with pytest.raises(NotImplementedError, match=knob):
+            apply_strategy(model, opt, strategy)
+
+
+def test_group_sharded_offload_raises():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    model = nn.Linear(4, 4)
+    opt = optim.Adam(learning_rate=0.1, parameters=model.parameters())
+    with pytest.raises(NotImplementedError, match="offload"):
+        group_sharded_parallel(model, opt, level="os_g", offload=True)
+    with pytest.warns(UserWarning, match="subsumed"):
+        group_sharded_parallel(model, opt, level="os_g",
+                               sync_buffers=True)
+
+
+def test_strategy_sharding_offload_raises():
+    """The strategy path must hit the same offload honesty check as the
+    direct group_sharded_parallel call."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_optimizer_factory import (
+        apply_strategy)
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 3, "offload": True}
+    model = nn.Linear(4, 4)
+    opt = optim.Adam(learning_rate=0.1, parameters=model.parameters())
+    with pytest.raises(NotImplementedError, match="offload"):
+        apply_strategy(model, opt, strategy)
+
+
+def test_strategy_amp_o1_wires_autocast():
+    """strategy.amp=True default configs → O1 via compiled-step
+    auto_cast (was a silent fp32 no-op, ADVICE r2 #3)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_optimizer_factory import (
+        apply_strategy)
+    from paddle_tpu.jit import TrainStepCompiler
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    model = nn.Linear(8, 8)
+    opt = optim.SGD(learning_rate=0.1, parameters=model.parameters())
+    model, opt, kw = apply_strategy(model, opt, strategy)
+    assert kw.get("amp_level") == "O1"
+    assert kw.get("amp_dtype") == "bfloat16"
+
+    # the compiled step really runs allow-listed ops in bf16: capture
+    # the matmul input dtype through a probe layer
+    seen = {}
+
+    class Probe(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            out = self.fc(x)
+            seen["dtype"] = out._value.dtype
+            return out.astype("float32")
+
+    m = Probe()
+    o = optim.SGD(learning_rate=0.1, parameters=m.parameters())
+    step = TrainStepCompiler(
+        m, o, loss_fn=lambda out, lbl: (out - lbl).square().mean(), **kw)
+    x = paddle.randn([2, 8])
+    y = paddle.randn([2, 8])
+    loss = step(x, y)
+    assert np.isfinite(float(loss.item()))
+    assert seen["dtype"] == jnp.bfloat16
