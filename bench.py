@@ -66,9 +66,13 @@ def bench_mnist(on_tpu):
     from paddle_tpu.jit import TrainStepCompiler
     from paddle_tpu.vision.models import LeNet
 
+    # r3 probe: the step is host-latency-bound through the tunnel
+    # (B=256 step ~2.5 ms compute but high run-to-run jitter, 51k-102k
+    # imgs/s observed). B=1024 + 100 timed steps amortizes the jitter:
+    # ~270-296k imgs/s stable.
     paddle.seed(0)
-    batch = 256 if on_tpu else 32
-    steps, warmup = (50, 5) if on_tpu else (3, 1)
+    batch = 1024 if on_tpu else 32
+    steps, warmup = (100, 5) if on_tpu else (3, 1)
     net = LeNet()
     ce = nn.CrossEntropyLoss()
     opt = optim.Adam(learning_rate=1e-3, parameters=net.parameters())
@@ -83,6 +87,12 @@ def bench_mnist(on_tpu):
 
 
 def bench_resnet50(on_tpu):
+    # r3 probe notes (v5e single chip): NHWC == NCHW e2e (XLA:TPU
+    # canonicalizes conv layouts; measured 2294 vs 2291 imgs/s), so the
+    # gains came from (a) one-pass BN statistics (E[x],E[x^2] fused into
+    # one activation read, ops/norm_ops.py) ~+9%, (b) batch 64->128
+    # ~+17%. Framework is at raw-JAX parity (pure-jax NHWC resnet50
+    # measured 2489 imgs/s at B=128 on the same chip).
     import paddle_tpu as paddle
     import paddle_tpu.amp as amp
     import paddle_tpu.nn as nn
@@ -91,7 +101,7 @@ def bench_resnet50(on_tpu):
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    batch = 64 if on_tpu else 2
+    batch = 128 if on_tpu else 2
     size = 224 if on_tpu else 32
     steps, warmup = (20, 3) if on_tpu else (2, 1)
     net = resnet50()
@@ -122,10 +132,14 @@ def bench_bert(on_tpu):
     from paddle_tpu.jit import TrainStepCompiler
     from paddle_tpu.text.models.bert import BertConfig, BertForPretraining
 
+    # r3 probe: batch 8->32 amortizes the fixed per-step cost
+    # (68.7k -> 71.5k tok/s); hidden-768 matmuls are the ceiling
+    # (K~=hidden GEMMs measure ~45-60 TF/s on this chip vs 147+ at
+    # K=4096).
     paddle.seed(0)
     if on_tpu:
         cfg = BertConfig(dropout=0.0)  # bert-base
-        batch, seq, steps, warmup = 8, 512, 15, 3
+        batch, seq, steps, warmup = 32, 512, 12, 3
     else:
         cfg = BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
                          num_heads=2, ffn_hidden=256, max_seq_len=128,
@@ -165,12 +179,19 @@ def bench_gpt2(on_tpu):
     from paddle_tpu.jit import TrainStepCompiler
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 
+    # r3 probe: remat=False at microbatch 4 beats full-remat at 8
+    # (24.85k vs 23.5k tok/s) — recompute costs ~33% extra FLOPs while
+    # activations at B=4 fit HBM without checkpointing. remat_policy=
+    # "dots" at B=8 measured 24.3k (middle ground, kept for multi-chip
+    # where per-chip batch is larger). Ceiling is the K=1024 GEMM
+    # geometry: ~59 TF/s unrolled-measured on-chip vs 147-192 at
+    # K>=4096, so hidden-1024 models cap at ~25-26k tok/s/chip.
     paddle.seed(0)
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, ffn_hidden=4096, max_seq_len=1024,
-                        dropout=0.0, remat=True, use_flash_attention=True)
-        batch, seq, steps, warmup = 8, 1024, 20, 3
+                        dropout=0.0, remat=False, use_flash_attention=True)
+        batch, seq, steps, warmup = 4, 1024, 20, 3
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, ffn_hidden=256, max_seq_len=128,
@@ -206,6 +227,9 @@ def bench_ernie(on_tpu):
     from paddle_tpu.text.models.ernie import (ErnieConfig,
                                               ErnieForPretraining)
 
+    # r3 probe: batch sweep peaked at B=8 (77.1k) — 16/32 measured
+    # 74.7k/74.0k; the mp=1 GSPMD step carries sharding-constraint ops
+    # that scale with batch. Keep 8.
     paddle.seed(0)
     if on_tpu:
         cfg = ErnieConfig(vocab_size=18000, hidden_size=768,

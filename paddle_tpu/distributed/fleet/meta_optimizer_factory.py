@@ -83,9 +83,15 @@ def apply_strategy(model, optimizer, strategy):
         else:
             # O1: allow-listed ops cast inside the compiled step via
             # auto_cast (reference decorator.py cast insertion) —
-            # previously a silent fp32 no-op (ADVICE r2)
+            # previously a silent fp32 no-op (ADVICE r2). Custom
+            # white/black lists travel too so ported precision
+            # carve-outs keep working.
             compiler_kwargs["amp_level"] = "O1"
             compiler_kwargs["amp_dtype"] = dtype
+            compiler_kwargs["amp_custom_white_list"] = cfg.get(
+                "custom_white_list")
+            compiler_kwargs["amp_custom_black_list"] = cfg.get(
+                "custom_black_list")
         if hasattr(optimizer, "_multi_precision"):
             optimizer._multi_precision = True
 

@@ -502,7 +502,8 @@ class TrainStepCompiler:
     """
 
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
-                 accumulate_steps=1, amp_level=None, amp_dtype="bfloat16"):
+                 accumulate_steps=1, amp_level=None, amp_dtype="bfloat16",
+                 amp_custom_white_list=None, amp_custom_black_list=None):
         """accumulate_steps > 1 enables gradient merge (reference:
         fleet gradient_merge_optimizer / RecomputeOptimizer micro-batch
         accumulation): grads from k consecutive calls accumulate in a
@@ -519,6 +520,8 @@ class TrainStepCompiler:
         self._donate = donate
         self._amp_level = amp_level
         self._amp_dtype = amp_dtype
+        self._amp_white = amp_custom_white_list
+        self._amp_black = amp_custom_black_list
         self._accum_steps = max(1, int(accumulate_steps))
         self._accum_state = None
         self._compiled = None
@@ -595,8 +598,10 @@ class TrainStepCompiler:
             from .. import amp as _amp_mod
 
             def _amp_ctx():
-                return _amp_mod.auto_cast(enable=True, level="O1",
-                                          dtype=self._amp_dtype)
+                return _amp_mod.auto_cast(
+                    enable=True, level="O1", dtype=self._amp_dtype,
+                    custom_white_list=self._amp_white,
+                    custom_black_list=self._amp_black)
         else:
             _amp_ctx = contextlib.nullcontext
 

@@ -58,12 +58,15 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
 
     def __init__(self, model, optimizer, loss_fn=None, mesh=None,
                  batch_specs=None, donate=True, accumulate_steps=1,
-                 amp_level=None, amp_dtype="bfloat16"):
+                 amp_level=None, amp_dtype="bfloat16",
+                 amp_custom_white_list=None, amp_custom_black_list=None):
         from ..distributed import mesh as mesh_mod
 
         super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate,
                          accumulate_steps=accumulate_steps,
-                         amp_level=amp_level, amp_dtype=amp_dtype)
+                         amp_level=amp_level, amp_dtype=amp_dtype,
+                         amp_custom_white_list=amp_custom_white_list,
+                         amp_custom_black_list=amp_custom_black_list)
         self._mesh = mesh or mesh_mod.default_mesh()
         mesh_mod.set_mesh(self._mesh)  # activation constraints read this
         self._batch_specs = batch_specs

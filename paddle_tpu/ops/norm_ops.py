@@ -56,11 +56,31 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
 
 def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
                   channel_axis):
+    """TPU-tuned BN: statistics in f32 via ONE pass (E[x], E[x²] fused
+    into a single read of x — jnp.var's two-pass form reads the
+    activation twice and measurably slows ResNet-50 on v5e), then the
+    normalization applied as a per-channel affine in the INPUT dtype so
+    the bf16 activation never round-trips through an f32 copy. Matches
+    reference batch_norm_op numerics at bf16 resolution (stats f32)."""
     reduce_axes = tuple(a for a in range(x.ndim) if a != channel_axis)
     if training:
         xf = x.astype(jnp.float32)
-        batch_mean = jnp.mean(xf, axis=reduce_axes)
-        batch_var = jnp.var(xf, axis=reduce_axes)
+        # shifted one-pass variance: E[(x-s)^2] - (E[x]-s)^2 with s =
+        # one sample per channel. Naive E[x^2]-E[x]^2 catastrophically
+        # cancels in f32 when |mean| >> std (e.g. un-normalized image
+        # input); shifting by any value near the data's magnitude makes
+        # both terms O(var), keeping the single fused read of x.
+        shift = jax.lax.stop_gradient(
+            jnp.mean(jax.lax.slice_in_dim(xf, 0, 1, axis=0),
+                     axis=reduce_axes))
+        sh = shift.reshape([1 if a != channel_axis else -1
+                            for a in range(x.ndim)])
+        xc = xf - sh
+        batch_mean_c = jnp.mean(xc, axis=reduce_axes)
+        batch_var = (jnp.mean(xc * xc, axis=reduce_axes)
+                     - batch_mean_c ** 2)
+        batch_var = jnp.maximum(batch_var, 0.0)
+        batch_mean = batch_mean_c + shift
         use_mean, use_var = batch_mean, batch_var
         n = x.size // x.shape[channel_axis]
         unbiased = batch_var * (n / max(n - 1, 1))
@@ -71,12 +91,15 @@ def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
         new_mean, new_var = mean, var
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
-    out = ((x.astype(jnp.float32) - use_mean.reshape(shape))
-           * jax.lax.rsqrt(use_var.reshape(shape) + eps))
-    if weight is not None:
-        out = out * weight.reshape(shape)
+    inv = jax.lax.rsqrt(use_var + eps)
+    scale = inv if weight is None else inv * weight.astype(jnp.float32)
+    shift = -use_mean * scale
     if bias is not None:
-        out = out + bias.reshape(shape)
+        shift = shift + bias.astype(jnp.float32)
+    out = (x.astype(jnp.float32) * scale.reshape(shape)
+           + shift.reshape(shape)) if x.dtype == jnp.float32 else (
+        x * scale.reshape(shape).astype(x.dtype)
+        + shift.reshape(shape).astype(x.dtype))
     return out.astype(x.dtype), new_mean, new_var
 
 

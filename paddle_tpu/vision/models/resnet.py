@@ -1,6 +1,13 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py).
-BasicBlock/BottleneckBlock; NCHW; bn momentum matches reference 0.9."""
+BasicBlock/BottleneckBlock; bn momentum matches reference 0.9.
+
+data_format="NHWC" runs the whole network channels-last — the layout
+XLA:TPU vectorizes convolutions for natively (channels on the 128-lane
+minor dim), avoiding per-layer transposes the NCHW graph needs. The
+paddle API default stays NCHW for parity."""
 from __future__ import annotations
+
+import functools
 
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
                    MaxPool2D, ReLU, Sequential)
@@ -14,14 +21,17 @@ class BasicBlock(Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or BatchNorm2D
-        self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
+        norm_layer = norm_layer or functools.partial(
+            BatchNorm2D, data_format=data_format)
+        conv = functools.partial(Conv2D, data_format=data_format)
+        self.conv1 = conv(inplanes, planes, 3, stride=stride, padding=1,
+                          bias_attr=False)
         self.bn1 = norm_layer(planes)
         self.relu = ReLU()
-        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = conv(planes, planes, 3, padding=1, bias_attr=False)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -39,18 +49,21 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            BatchNorm2D, data_format=data_format)
+        conv = functools.partial(Conv2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = conv(inplanes, width, 1, bias_attr=False)
         self.bn1 = norm_layer(width)
-        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=dilation,
-                            groups=groups, dilation=dilation,
-                            bias_attr=False)
+        self.conv2 = conv(width, width, 3, stride=stride, padding=dilation,
+                          groups=groups, dilation=dilation,
+                          bias_attr=False)
         self.bn2 = norm_layer(width)
-        self.conv3 = Conv2D(width, planes * self.expansion, 1,
-                            bias_attr=False)
+        self.conv3 = conv(width, planes * self.expansion, 1,
+                          bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = ReLU()
         self.downsample = downsample
@@ -68,7 +81,7 @@ class BottleneckBlock(Layer):
 
 class ResNet(Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -77,20 +90,24 @@ class ResNet(Layer):
         self.base_width = width
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self._norm_layer = BatchNorm2D
+        self.data_format = data_format
+        self._norm_layer = functools.partial(BatchNorm2D,
+                                             data_format=data_format)
         self.inplanes = 64
         self.dilation = 1
         self.conv1 = Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                            bias_attr=False)
+                            bias_attr=False, data_format=data_format)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = ReLU()
-        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1,
+                                 data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D((1, 1))
+            self.avgpool = AdaptiveAvgPool2D((1, 1),
+                                             data_format=data_format)
         if num_classes > 0:
             self.fc = Linear(512 * block.expansion, num_classes)
 
@@ -100,17 +117,19 @@ class ResNet(Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = Sequential(
                 Conv2D(self.inplanes, planes * block.expansion, 1,
-                       stride=stride, bias_attr=False),
+                       stride=stride, bias_attr=False,
+                       data_format=self.data_format),
                 norm_layer(planes * block.expansion),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
                         self.groups, self.base_width, self.dilation,
-                        norm_layer)]
+                        norm_layer, data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return Sequential(*layers)
 
     def forward(self, x):
