@@ -10,6 +10,7 @@ from .layer.loss import *  # noqa
 from .layer.transformer import *  # noqa
 from .layer.rnn import *  # noqa
 from .layer.vision import *  # noqa
+from .layer.decode import *  # noqa
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
 from .param_attr import ParamAttr
 from . import functional

@@ -13,6 +13,7 @@ from ...core.tensor import Tensor
 from ...ops.activation import *  # noqa: F401,F403
 from ...ops.conv import *  # noqa: F401,F403
 from ...ops.loss_ops import *  # noqa: F401,F403
+from ...ops.decode import edit_distance  # noqa: F401
 from ...ops.norm_ops import *  # noqa: F401,F403
 from ...ops.manipulation import pad  # noqa: F401
 from ...ops.creation import one_hot  # noqa: F401

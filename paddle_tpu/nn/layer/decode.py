@@ -1,0 +1,191 @@
+"""Beam-search decoding layers.
+
+Parity target: python/paddle/fluid/layers/rnn.py `BeamSearchDecoder`
+(:~255) and `dynamic_decode` (:~1135), which lower to
+beam_search_op.cc / beam_search_decode_op.cc in the reference.
+
+TPU-native design: the beam lives as a static [batch, beam] lane
+dimension flattened into the cell batch. dynamic_decode drives a
+Python step loop over paddle ops (matching the reference's dygraph
+path) — under `to_static`/TrainStepCompiler the whole loop traces into
+one XLA program; for a single-program scan-based decoder see
+ops/decode.py beam_search_decode."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.engine import apply_op
+from ...core.tensor import Tensor
+from ...ops import creation as C
+from ...ops import manipulation as M
+from .layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+_NEG_INF = -1e9
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell for beam search (reference rnn.py:255).
+
+    embedding_fn maps token ids -> cell inputs; output_fn maps cell
+    outputs -> vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- reference API ------------------------------------------------
+    def initialize(self, initial_cell_states):
+        import jax.numpy as jnp
+
+        states = initial_cell_states
+        leaves = (states if isinstance(states, (tuple, list))
+                  else [states])
+        B = leaves[0].shape[0]
+        K = self.beam_size
+
+        def tile(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(tile(x) for x in s)
+            return apply_op("beam_tile",
+                            lambda v, K: jnp.repeat(v, K, axis=0), s, K=K)
+
+        cell_states = tile(states)
+        tokens = C.full([B * K], self.start_token, dtype="int64")
+        # lane 0 live, others dead so identical start states don't
+        # produce K copies of the same hypothesis
+        lp0 = np.full((B, K), _NEG_INF, np.float32)
+        lp0[:, 0] = 0.0
+        log_probs = Tensor(jnp.asarray(lp0.reshape(-1)),
+                           stop_gradient=True, _internal=True)
+        finished = C.zeros([B * K], dtype="bool")
+        init_inputs = (self.embedding_fn(tokens)
+                       if self.embedding_fn is not None else tokens)
+        return init_inputs, (cell_states, log_probs, finished, tokens), \
+            finished
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax.numpy as jnp
+
+        cell_states, log_probs, finished, tokens = states
+        K = self.beam_size
+        cell_out, next_cell_states = self.cell(inputs, cell_states)
+        logits = (self.output_fn(cell_out)
+                  if self.output_fn is not None else cell_out)
+        V = logits.shape[-1]
+
+        def _k(logits, lp, fin):
+            import jax
+
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            frozen = jnp.full(lsm.shape, _NEG_INF, jnp.float32
+                              ).at[:, self.end_token].set(0.0)
+            lsm = jnp.where(fin[:, None], frozen, lsm)
+            cand = (lp[:, None] + lsm).reshape(-1, K * V)  # [B, K*V]
+            import jax
+
+            top_lp, top_idx = jax.lax.top_k(cand, K)
+            parent = (top_idx // V).astype(jnp.int32)  # [B, K]
+            tok = (top_idx % V).astype(jnp.int64)
+            B = cand.shape[0]
+            flat_parent = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
+                           + parent).reshape(-1)
+            return (top_lp.reshape(-1), tok.reshape(-1), flat_parent)
+
+        new_lp, new_tokens, flat_parent = apply_op(
+            "beam_search_step", _k, logits, log_probs, finished)
+
+        def gather_state(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(gather_state(x) for x in s)
+            return M.gather(s, flat_parent, axis=0)
+
+        next_cell_states = gather_state(next_cell_states)
+        prev_finished = M.gather(finished, flat_parent, axis=0)
+        import paddle_tpu.ops.logic as L
+
+        new_finished = L.logical_or(
+            prev_finished,
+            L.equal(new_tokens, C.full_like(new_tokens, self.end_token)))
+        next_inputs = (self.embedding_fn(new_tokens)
+                       if self.embedding_fn is not None else new_tokens)
+        outputs = {"scores": new_lp, "predicted_ids": new_tokens,
+                   "parent_ids": flat_parent}
+        return outputs, (next_cell_states, new_lp, new_finished,
+                         new_tokens), next_inputs, new_finished
+
+    def finalize(self, step_outputs, final_states, K):
+        """Backtrack through parent pointers (beam_search_decode_op
+        analog) -> sequences [B, K, T] best-first + scores [B, K]."""
+        import jax.numpy as jnp
+
+        toks = M.stack([o["predicted_ids"] for o in step_outputs], axis=0)
+        parents = M.stack([o["parent_ids"] for o in step_outputs], axis=0)
+        final_lp = final_states[1]
+
+        def _k(toks, parents, lp):
+            import jax
+
+            T, BK = toks.shape
+            lane = jnp.arange(BK)
+
+            def back(lane, t):
+                tok_t = toks[t][lane]
+                return parents[t][lane], tok_t
+
+            _, rev = jax.lax.scan(back, lane,
+                                  jnp.arange(T - 1, -1, -1))
+            seqs = jnp.flip(rev, axis=0).T.reshape(-1, K, T)
+            scores = lp.reshape(-1, K)
+            order = jnp.argsort(-scores, axis=1)
+            seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+            scores = jnp.take_along_axis(scores, order, axis=1)
+            return seqs, scores
+
+        return apply_op("beam_search_finalize", _k, toks, parents,
+                        final_lp)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run a decoder to completion (reference rnn.py dynamic_decode):
+    steps until every beam lane is finished or max_step_num. Returns
+    (outputs, final_states) where outputs = (sequences [B,K,T], scores)
+    for BeamSearchDecoder; with return_length, appends lengths."""
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode: max_step_num is required — the TPU build "
+            "compiles a bounded decode loop (static shapes), matching "
+            "the reference's max_step_num semantics")
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, finished = decoder.step(t, inputs,
+                                                         states, **kwargs)
+        step_outputs.append(outputs)
+        import jax.core as _jcore
+
+        if not isinstance(finished._value, _jcore.Tracer) and bool(
+                np.asarray(finished._value).all()):
+            break  # eager early exit; traced decode runs the full bound
+    seqs, scores = decoder.finalize(step_outputs, states,
+                                    decoder.beam_size)
+    if return_length:
+        import jax.numpy as jnp
+
+        lengths = apply_op(
+            "decode_lengths",
+            lambda s, e: jnp.argmax(
+                jnp.concatenate([(s == e), jnp.ones_like(s[..., :1],
+                                                         dtype=bool)],
+                                axis=-1), axis=-1).astype(jnp.int64),
+            seqs, e=decoder.end_token)
+        return (seqs, scores), states, lengths
+    return (seqs, scores), states

@@ -10,6 +10,7 @@ __all__ = [
     "HingeEmbeddingLoss", "CosineEmbeddingLoss", "SoftMarginLoss",
     "MultiLabelSoftMarginLoss", "TripletMarginLoss",
     "TripletMarginWithDistanceLoss", "PoissonNLLLoss", "GaussianNLLLoss",
+    "CTCLoss",
 ]
 
 
@@ -205,3 +206,20 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class CTCLoss(Layer):
+    """paddle.nn.CTCLoss (reference nn/layer/loss.py CTCLoss over
+    warpctc): log_probs [T, B, C] logits, labels [B, L]."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, blank=self.blank,
+                          reduction=self.reduction,
+                          norm_by_times=norm_by_times)

@@ -24,7 +24,7 @@ __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
     "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "grid_sample",
-    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "affine_grid", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
 ]
 
 
@@ -384,6 +384,41 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
 
     return apply_op("channel_shuffle", _k, x, g=int(groups),
                     channel_last=data_format == "NHWC")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D affine sampling grid (reference affine_grid_op.h /
+    python/paddle/nn/functional/vision.py affine_grid): theta
+    [N, 2, 3] + out_shape [N, C, H, W] -> grid [N, H, W, 2] in [-1, 1]
+    base coordinates, consumed by grid_sample."""
+    shape = [int(s) for s in (out_shape.tolist()
+                              if hasattr(out_shape, "tolist")
+                              else out_shape)]
+    if len(shape) != 4:
+        raise NotImplementedError(
+            "affine_grid: only the 4-D (2D spatial) case is implemented "
+            "— 5-D/3D grids raise for now")
+    _, _, H, W = shape
+
+    def _k(th, H, W, align):
+        def linspace(n):
+            if align:
+                return jnp.linspace(-1.0, 1.0, n, dtype=jnp.float32)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n,
+                                dtype=jnp.float32)
+
+        ys = linspace(H)
+        xs = linspace(W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        out = jnp.einsum("hwk,nck->nhwc", base,
+                         th.astype(jnp.float32))  # [N, H, W, 2]
+        return out
+
+    return apply_op("affine_grid", _k, theta, H=H, W=W,
+                    align=bool(align_corners))
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",

@@ -1,0 +1,188 @@
+"""Decoding operators: edit_distance, beam search.
+
+Parity targets:
+- edit_distance: paddle/fluid/operators/edit_distance_op.h (batched
+  Levenshtein DP), python/paddle/fluid/layers/nn.py edit_distance.
+- beam search: paddle/fluid/operators/beam_search_op.cc +
+  beam_search_decode_op.cc, and the 2.x API
+  python/paddle/fluid/layers/rnn.py BeamSearchDecoder / dynamic_decode.
+
+TPU-native design: the reference's per-step beam_search op keeps LoD
+candidate lists of data-dependent width; here the beam is a STATIC
+[batch, beam] lane through one `lax.scan` — log-prob accumulation,
+finished-lane freezing and end-token forcing are masked updates, and
+backtracking gathers through the stored parent indices (the
+beam_search_decode analog) inside the same compiled program.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["edit_distance", "beam_search_decode"]
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (edit_distance_op.h).
+
+    input [B, T1], label [B, T2] padded int token ids; lengths [B]
+    (default: full width). Returns (dist [B, 1] float32, seq_num [1]).
+    normalized=True divides by the label length. ignored_tokens are
+    removed from both sides before the DP (host-static removal is not
+    possible with padded device inputs, so ignored tokens are masked by
+    shifting them to a sentinel that never matches and reducing the
+    effective lengths)."""
+    def _k(a, b, a_len, b_len, ignored, normalized):
+        B, T1 = a.shape
+        T2 = b.shape[1]
+        a = a.astype(jnp.int32)
+        b = b.astype(jnp.int32)
+        if a_len is None:
+            a_len = jnp.full((B,), T1, jnp.int32)
+        else:
+            a_len = a_len.reshape(-1).astype(jnp.int32)
+        if b_len is None:
+            b_len = jnp.full((B,), T2, jnp.int32)
+        else:
+            b_len = b_len.reshape(-1).astype(jnp.int32)
+        if ignored:
+            ig = jnp.asarray(ignored, jnp.int32)
+
+            def squeeze(x, ln, T):
+                keep = (jnp.arange(T)[None, :] < ln[:, None]) & ~jnp.isin(
+                    x, ig)
+                # stable-compact kept tokens to the left
+                order = jnp.argsort(~keep, axis=1, stable=True)
+                return (jnp.take_along_axis(x, order, axis=1),
+                        keep.sum(axis=1).astype(jnp.int32))
+
+            a, a_len = squeeze(a, a_len, T1)
+            b, b_len = squeeze(b, b_len, T2)
+
+        big = jnp.float32(1e9)
+        # DP over rows of the (T1+1) x (T2+1) table; carry = previous row
+        js = jnp.arange(T2 + 1, dtype=jnp.float32)
+        row0 = jnp.broadcast_to(js, (B, T2 + 1))
+        # mask positions beyond b_len with +inf-ish so they never win,
+        # but keep column b_len reachable
+        def dp_row(prev, i):
+            # prev: [B, T2+1] row i-1; compute row i
+            ai = jnp.take_along_axis(
+                a, jnp.minimum(i - 1, T1 - 1)[None].repeat(B, 0)[:, None],
+                axis=1)[:, 0]  # token a[i-1]
+            sub_cost = (ai[:, None] != b).astype(jnp.float32)  # [B, T2]
+
+            def col(carry, j):
+                left = carry  # row[i][j-1]
+                up = prev[:, j]
+                diag = prev[:, j - 1]
+                v = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0),
+                                diag + sub_cost[:, j - 1])
+                return v, v
+
+            first = prev[:, 0] + 1.0  # row[i][0] = i
+            _, cols = jax.lax.scan(col, first, jnp.arange(1, T2 + 1))
+            row = jnp.concatenate([first[None], cols], axis=0).T
+            # rows beyond a_len stay frozen (we read row a_len at the end)
+            active = (i <= a_len)[:, None]
+            return jnp.where(active, row, prev), None
+
+        last, _ = jax.lax.scan(dp_row, row0, jnp.arange(1, T1 + 1))
+        dist = jnp.take_along_axis(last, b_len[:, None], axis=1)[:, 0]
+        if normalized:
+            dist = dist / jnp.maximum(b_len.astype(jnp.float32), 1.0)
+        return dist[:, None], jnp.asarray([B], jnp.int64)
+
+    return apply_op("edit_distance", _k, input, label, input_length,
+                    label_length,
+                    ignored=tuple(ignored_tokens or ()),
+                    normalized=bool(normalized))
+
+
+def beam_search_decode(step_fn, init_state, start_token, end_token,
+                       beam_size, max_step_num, vocab_size,
+                       length_penalty=0.0):
+    """Standalone functional beam search (beam_search_op.cc +
+    beam_search_decode_op.cc capability in one compiled program).
+
+    step_fn(token_ids [B*K], state) -> (log_probs [B*K, V], new_state):
+    one decoder step. Returns (token_ids [B, K, T], scores [B, K])
+    sorted best-first. See nn.BeamSearchDecoder for the Layer/cell API.
+    """
+    def _k(init_state):
+        return _beam_search(step_fn, init_state, start_token, end_token,
+                            beam_size, max_step_num, vocab_size,
+                            length_penalty)
+
+    return apply_op("beam_search", _k, init_state)
+
+
+def _beam_search(step_fn, init_state, start_token, end_token, K,
+                 max_steps, V, length_penalty):
+    state0 = init_state
+    leaves = jax.tree_util.tree_leaves(state0)
+    B = leaves[0].shape[0] if leaves else 1
+    neg_inf = jnp.float32(-1e9)
+
+    # tile state to beams: [B, ...] -> [B*K, ...]
+    def tile(x):
+        return jnp.repeat(x, K, axis=0)
+
+    state = jax.tree_util.tree_map(tile, state0)
+    tokens = jnp.full((B * K,), start_token, jnp.int32)
+    # lane 0 active, others dead (all start states identical)
+    lp = jnp.where(jnp.arange(B * K) % K == 0, 0.0, neg_inf)
+    finished = jnp.zeros((B * K,), bool)
+    lengths = jnp.zeros((B * K,), jnp.int32)
+
+    def step(carry, t):
+        tokens, lp, finished, lengths, state = carry
+        logp, new_state = step_fn(tokens, state)
+        logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        # finished lanes only extend with end_token at no cost
+        frozen = jnp.full((B * K, V), neg_inf).at[:, end_token].set(0.0)
+        logp = jnp.where(finished[:, None], frozen, logp)
+        cand = lp[:, None] + logp  # [B*K, V]
+        cand = cand.reshape(B, K * V)
+        top_lp, top_idx = jax.lax.top_k(cand, K)  # [B, K]
+        parent = top_idx // V  # lane within beam
+        tok = (top_idx % V).astype(jnp.int32)
+        flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        new_tokens = tok.reshape(-1)
+        new_lp = top_lp.reshape(-1)
+        new_finished = (finished[flat_parent]
+                        | (new_tokens == end_token))
+        new_lengths = lengths[flat_parent] + jnp.where(
+            finished[flat_parent], 0, 1)
+        new_state = jax.tree_util.tree_map(
+            lambda x: x[flat_parent], new_state)
+        out = (new_tokens, flat_parent)
+        return ((new_tokens, new_lp, new_finished, new_lengths,
+                 new_state), out)
+
+    (tokens, lp, finished, lengths, state), (toks, parents) = \
+        jax.lax.scan(step, (tokens, lp, finished, lengths, state),
+                     jnp.arange(max_steps))
+    # backtrack: toks/parents [T, B*K] -> sequences [B*K, T]
+    def back(carry, t):
+        lane = carry  # [B*K] current lane at step t+1 ... start from end
+        tok_t = toks[t][lane]
+        lane_prev = parents[t][lane]
+        return lane_prev, tok_t
+
+    lane0 = jnp.arange(B * K)
+    _, rev = jax.lax.scan(back, lane0, jnp.arange(max_steps - 1, -1, -1))
+    seqs = jnp.flip(rev, axis=0).T.reshape(B, K, max_steps)
+    scores = lp.reshape(B, K)
+    if length_penalty:
+        scores = scores / (lengths.reshape(B, K).astype(jnp.float32)
+                           ** length_penalty).clip(1.0)
+    order = jnp.argsort(-scores, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores

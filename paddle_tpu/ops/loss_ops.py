@@ -21,6 +21,7 @@ __all__ = [
     "dice_loss", "npair_loss", "triplet_margin_loss",
     "triplet_margin_with_distance_loss", "soft_margin_loss",
     "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "ctc_loss",
 ]
 
 
@@ -427,3 +428,81 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
 
     return apply_op("gaussian_nll_loss", _k, input, label, variance,
                     full=bool(full), eps=float(epsilon), reduction=reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Parity: python/paddle/nn/functional/loss.py ctc_loss over
+    paddle/fluid/operators/warpctc_op.cc — same convention: `log_probs`
+    is [T, B, C] UNNORMALIZED logits (log_softmax applied internally,
+    like warpctc), labels [B, L] padded, per-sample lengths.
+
+    TPU-native: the standard log-semiring alpha recursion as ONE
+    `lax.scan` over time — blanks interleaved statically (S = 2L+1),
+    per-sample termination handled by masking the carry past
+    input_lengths, so the whole batch is a single static-shaped XLA
+    while loop. Gradients come from autodiff through the scan (the
+    classic CTC beta-pass gradient is exactly autodiff of this forward).
+    """
+    def _k(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        # extended label row: [blank, l0, blank, l1, ..., blank]
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.float32(-1e30)
+        # transition-allowed-from-s-2: ext[s] != blank and != ext[s-2]
+        can_skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+        s_idx = jnp.arange(S)
+
+        # t=0 may start at the leading blank (s=0) or the first label
+        # (s=1); everything else is impossible
+        alpha0 = jnp.where(s_idx[None, :] < 2,
+                           jnp.take_along_axis(lp[0], ext, axis=1),
+                           neg_inf)
+
+        def lse(a, b):
+            m = jnp.maximum(a, b)
+            m_ok = jnp.maximum(m, neg_inf)
+            return m_ok + jnp.log(jnp.exp(a - m_ok) + jnp.exp(b - m_ok))
+
+        def step(alpha, t):
+            prev = alpha
+            shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), prev[:, :-1]], axis=1)
+            shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), prev[:, :-2]], axis=1)
+            acc = lse(prev, shift1)
+            acc = jnp.where(can_skip, lse(acc, shift2), acc)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = acc + emit
+            # past this sample's input length: freeze alpha
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, prev), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # terminal states: s = 2*lab_len (final blank) and 2*lab_len-1
+        end = (2 * lab_len).astype(jnp.int32)
+        a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+        a_end1 = jnp.take_along_axis(
+            alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+        ll = lse(a_end, jnp.where(end >= 1, a_end1, neg_inf))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # paddle parity (nn/functional/loss.py ctc_loss): mean of
+            # per-sample loss NORMALIZED by its label length
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op("ctc_loss", _k, log_probs, labels, input_lengths,
+                    label_lengths)

@@ -1,0 +1,315 @@
+"""Sequence (LoD) operators.
+
+Parity target: paddle/fluid/operators/sequence_ops/ (sequence_pool_op,
+sequence_softmax_op, sequence_expand_op, sequence_conv_op,
+sequence_reverse_op, sequence_pad_op, sequence_unpad_op) exposed in 2.x
+as paddle.static.nn.sequence_*.
+
+TPU-native design: a LoDTensor is dense rows + HOST-side offsets
+(core/lod.py — metadata only). Because the offsets are host metadata,
+segment structure is STATIC under jit: kernels compile to
+segment-sum/max/gather programs with fixed shapes, which is exactly the
+dense+mask lowering SURVEY §7 hard-part (b) prescribes. Each op accepts
+a LoDTensor (or a (tensor, lengths) pair where noted) and returns
+LoDTensor/Tensor like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import apply_op
+from ..core.lod import LoDTensor
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_expand",
+    "sequence_expand_as", "sequence_conv", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_enumerate",
+]
+
+
+def _offsets(x, name):
+    if not isinstance(x, LoDTensor) or not x.lod():
+        raise ValueError(
+            f"{name}: input must be a LoDTensor with level-1 LoD "
+            "(dense rows + sequence offsets) — wrap your tensor with "
+            "paddle.LoDTensor(values, lod=[[0, n1, n1+n2, ...]])")
+    return [int(o) for o in x.lod()[-1]]
+
+
+def _seg_ids(offs):
+    n = offs[-1]
+    ids = np.zeros(n, np.int32)
+    for s, (a, b) in enumerate(zip(offs, offs[1:])):
+        ids[a:b] = s
+    return ids
+
+
+def _values(x):
+    return x._tensor if isinstance(x, LoDTensor) else x
+
+
+def sequence_pool(input, pool_type="average", is_test=False,
+                  pad_value=0.0, name=None):
+    """Per-sequence reduction over rows (sequence_pool_op.h). pool_type
+    in {average, sum, sqrt, max, min, last, first}; empty sequences
+    produce pad_value."""
+    offs = _offsets(input, "sequence_pool")
+    nseq = len(offs) - 1
+    ids = _seg_ids(offs)
+    lens = np.diff(offs)
+    pool_type = pool_type.lower()
+
+    def _k(v):
+        sid = jnp.asarray(ids)
+        ln = jnp.asarray(lens, v.dtype).reshape((-1,) + (1,) * (v.ndim - 1))
+        if pool_type in ("average", "sum", "sqrt"):
+            s = jax.ops.segment_sum(v, sid, num_segments=nseq)
+            if pool_type == "average":
+                out = s / jnp.maximum(ln, 1)
+            elif pool_type == "sqrt":
+                out = s / jnp.sqrt(jnp.maximum(ln, 1))
+            else:
+                out = s
+        elif pool_type == "max":
+            out = jax.ops.segment_max(v, sid, num_segments=nseq)
+        elif pool_type == "min":
+            out = jax.ops.segment_min(v, sid, num_segments=nseq)
+        elif pool_type in ("last", "first"):
+            idx = (np.asarray(offs[1:]) - 1 if pool_type == "last"
+                   else np.asarray(offs[:-1]))
+            # empty sequence -> clamp index; masked to pad below
+            idx = np.clip(idx, 0, max(offs[-1] - 1, 0))
+            out = v[jnp.asarray(idx)]
+        else:
+            raise ValueError(f"sequence_pool: bad pool_type {pool_type!r}")
+        empty = (ln == 0)
+        return jnp.where(empty, jnp.asarray(pad_value, v.dtype), out)
+
+    return apply_op("sequence_pool", _k, _values(input))
+
+
+def sequence_first_step(input, name=None):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input, name=None):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, name=None):
+    """Softmax within each sequence over the row dim
+    (sequence_softmax_op.h). Input rows are [T] or [T, 1]."""
+    offs = _offsets(input, "sequence_softmax")
+    ids = _seg_ids(offs)
+    nseq = len(offs) - 1
+
+    def _k(v):
+        flat = v.reshape(v.shape[0], -1)
+        sid = jnp.asarray(ids)
+        mx = jax.ops.segment_max(flat, sid, num_segments=nseq)
+        e = jnp.exp(flat - mx[sid])
+        s = jax.ops.segment_sum(e, sid, num_segments=nseq)
+        return (e / s[sid]).reshape(v.shape)
+
+    out = apply_op("sequence_softmax", _k, _values(input))
+    return LoDTensor(out, input.lod())
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Expand x's sequences by y's LoD at ref_level
+    (sequence_expand_op.h): sequence i of x is repeated as many times
+    as y's level has sub-sequences in entry i."""
+    y_lod = y.lod()[ref_level]
+    if isinstance(x, LoDTensor) and x.lod():
+        x_offs = _offsets(x, "sequence_expand")
+    else:
+        n = _values(x).shape[0]
+        x_offs = list(range(n + 1))  # each row its own sequence
+    reps = np.diff([int(o) for o in y_lod])
+    if len(reps) != len(x_offs) - 1:
+        raise ValueError(
+            f"sequence_expand: x has {len(x_offs) - 1} sequences but "
+            f"y's ref_level lod describes {len(reps)}")
+    gather, new_offs = [], [0]
+    for i, r in enumerate(reps):
+        a, b = x_offs[i], x_offs[i + 1]
+        for _ in range(int(r)):
+            gather.extend(range(a, b))
+            new_offs.append(new_offs[-1] + (b - a))
+    gidx = np.asarray(gather, np.int32)
+
+    def _k(v):
+        return v[jnp.asarray(gidx)]
+
+    out = apply_op("sequence_expand", _k, _values(x))
+    return LoDTensor(out, [new_offs])
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand each row/sequence of x to the length of y's matching
+    sequence (sequence_expand_as_op.h)."""
+    y_offs = _offsets(y, "sequence_expand_as")
+    n = (_values(x)).shape[0]
+    lens = np.diff(y_offs)
+    if len(lens) != n:
+        raise ValueError(
+            f"sequence_expand_as: x rows {n} != y sequences {len(lens)}")
+    gidx = np.repeat(np.arange(n, dtype=np.int32), lens)
+
+    def _k(v):
+        return v[jnp.asarray(gidx)]
+
+    out = apply_op("sequence_expand_as", _k, _values(x))
+    return LoDTensor(out, [list(np.concatenate([[0], np.cumsum(lens)]))])
+
+
+def sequence_conv(input, weight, filter_size=3, padding_start=None,
+                  bias=None, name=None):
+    """Context-window convolution over sequence rows
+    (sequence_conv_op.h ContextProjectFunctor): each output row is the
+    concat of `filter_size` context rows (zero-padded at sequence
+    boundaries) times `weight` [filter_size * D, M]. padding_start
+    defaults to -filter_size//2 (the reference's centered window)."""
+    offs = _offsets(input, "sequence_conv")
+    if padding_start is None:
+        padding_start = -int(filter_size // 2)
+    n = offs[-1]
+    d_gather = np.zeros((n, filter_size), np.int32)
+    d_mask = np.zeros((n, filter_size), np.float32)
+    for s, (a, b) in enumerate(zip(offs, offs[1:])):
+        for t in range(a, b):
+            for k in range(filter_size):
+                src = t + padding_start + k
+                if a <= src < b:
+                    d_gather[t, k] = src
+                    d_mask[t, k] = 1.0
+
+    def _k(v, w, bias_):
+        g = v[jnp.asarray(d_gather)]  # [T, F, D]
+        g = g * jnp.asarray(d_mask, v.dtype)[..., None]
+        ctx = g.reshape(g.shape[0], -1)  # [T, F*D]
+        out = ctx @ w
+        if bias_ is not None:
+            out = out + bias_
+        return out
+
+    out = apply_op("sequence_conv", _k, _values(input), weight, bias)
+    return LoDTensor(out, input.lod())
+
+
+def sequence_reverse(x, name=None):
+    """Reverse rows within each sequence (sequence_reverse_op.h)."""
+    offs = _offsets(x, "sequence_reverse")
+    gidx = np.arange(offs[-1], dtype=np.int32)
+    for a, b in zip(offs, offs[1:]):
+        gidx[a:b] = gidx[a:b][::-1]
+
+    def _k(v):
+        return v[jnp.asarray(gidx)]
+
+    out = apply_op("sequence_reverse", _k, _values(x))
+    return LoDTensor(out, x.lod())
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Ragged rows -> padded [N, L, ...] + lengths (sequence_pad_op.h)."""
+    offs = _offsets(x, "sequence_pad")
+    lens = np.diff(offs)
+    L = int(maxlen) if maxlen else int(lens.max() if len(lens) else 0)
+    if len(lens) and L < lens.max():
+        raise ValueError(f"sequence_pad: maxlen {L} < longest sequence "
+                         f"{int(lens.max())}")
+    n = len(lens)
+    gidx = np.zeros((n, L), np.int32)
+    mask = np.zeros((n, L), bool)
+    for i, (a, b) in enumerate(zip(offs, offs[1:])):
+        m = b - a
+        gidx[i, :m] = np.arange(a, b)
+        mask[i, :m] = True
+
+    def _k(v, pv):
+        g = v[jnp.asarray(gidx)]  # [N, L, ...]
+        mk = jnp.asarray(mask).reshape((n, L) + (1,) * (v.ndim - 1))
+        return jnp.where(mk, g, jnp.asarray(pv, v.dtype))
+
+    pad_v = (pad_value._value if isinstance(pad_value, Tensor)
+             else float(pad_value))
+    out = apply_op("sequence_pad", _k, _values(x), pv=pad_v)
+    return out, Tensor(jnp.asarray(lens, jnp.int64), stop_gradient=True,
+                       _internal=True)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [N, L, ...] + lengths -> ragged LoDTensor rows
+    (sequence_unpad_op.h). `length` must be host-concrete (it defines
+    the output row count)."""
+    lens = np.asarray(length._value if isinstance(length, Tensor)
+                      else length).astype(np.int64)
+    n, L = int(x.shape[0]), int(x.shape[1])
+    pairs = [(i, t) for i in range(n) for t in range(int(lens[i]))]
+    bi = np.asarray([p[0] for p in pairs], np.int32)
+    ti = np.asarray([p[1] for p in pairs], np.int32)
+
+    def _k(v):
+        return v[jnp.asarray(bi), jnp.asarray(ti)]
+
+    out = apply_op("sequence_unpad", _k, x)
+    offs = [0] + list(np.cumsum(lens))
+    return LoDTensor(out, [[int(o) for o in offs]])
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice (sequence_slice_op.h): from sequence i keep
+    rows [offset[i], offset[i]+length[i])."""
+    offs = _offsets(input, "sequence_slice")
+    off_a = np.asarray(offset._value if isinstance(offset, Tensor)
+                       else offset).reshape(-1).astype(np.int64)
+    len_a = np.asarray(length._value if isinstance(length, Tensor)
+                       else length).reshape(-1).astype(np.int64)
+    gather, new_offs = [], [0]
+    for i, (a, b) in enumerate(zip(offs, offs[1:])):
+        s = a + int(off_a[i])
+        e = s + int(len_a[i])
+        if not (a <= s and e <= b):
+            raise ValueError(
+                f"sequence_slice: slice [{off_a[i]}, {off_a[i]}+"
+                f"{len_a[i]}) out of bounds for sequence {i} of length "
+                f"{b - a}")
+        gather.extend(range(s, e))
+        new_offs.append(new_offs[-1] + (e - s))
+    gidx = np.asarray(gather, np.int32)
+
+    def _k(v):
+        return v[jnp.asarray(gidx)]
+
+    out = apply_op("sequence_slice", _k, _values(input))
+    return LoDTensor(out, [new_offs])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """All length-win_size subsequences per row position
+    (sequence_enumerate_op.h): out[t] = input[t:t+win] padded past the
+    sequence end."""
+    offs = _offsets(input, "sequence_enumerate")
+    n = offs[-1]
+    gidx = np.zeros((n, win_size), np.int32)
+    mask = np.zeros((n, win_size), bool)
+    for a, b in zip(offs, offs[1:]):
+        for t in range(a, b):
+            for k in range(win_size):
+                if t + k < b:
+                    gidx[t, k] = t + k
+                    mask[t, k] = True
+
+    def _k(v):
+        flat = v.reshape(v.shape[0])
+        g = flat[jnp.asarray(gidx)]
+        return jnp.where(jnp.asarray(mask), g,
+                         jnp.asarray(pad_value, v.dtype))
+
+    out = apply_op("sequence_enumerate", _k, _values(input))
+    return LoDTensor(out, input.lod())

@@ -13,9 +13,18 @@ from __future__ import annotations
 import numpy as np
 
 from .graph import cond, while_loop
+from ..ops.sequence import (  # noqa: F401 (re-exported, reference
+    sequence_pool, sequence_softmax, sequence_expand,  # static.nn.*)
+    sequence_expand_as, sequence_conv, sequence_reverse, sequence_pad,
+    sequence_unpad, sequence_first_step, sequence_last_step,
+    sequence_slice, sequence_enumerate)
 
 __all__ = ["fc", "cond", "while_loop", "switch_case", "embedding",
-           "batch_norm", "conv2d"]
+           "batch_norm", "conv2d",
+           "sequence_pool", "sequence_softmax", "sequence_expand",
+           "sequence_expand_as", "sequence_conv", "sequence_reverse",
+           "sequence_pad", "sequence_unpad", "sequence_first_step",
+           "sequence_last_step", "sequence_slice", "sequence_enumerate"]
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,

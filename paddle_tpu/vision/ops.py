@@ -1,55 +1,755 @@
-"""paddle.vision.ops (reference: python/paddle/vision/ops.py — yolo/roi
-ops + DeformConv; round-1 carries box utilities + nms)."""
+"""paddle.vision.ops — detection operator suite.
+
+Parity targets: python/paddle/vision/ops.py (roi_align:1145,
+roi_pool:1022, psroi_pool:911, yolo_box:252, deform_conv2d:423) and
+paddle/fluid/operators/detection/ (prior_box_op.h, box_coder_op.h,
+iou_similarity_op.h, yolo_box_op.h).
+
+TPU-native design notes:
+- Everything except `nms` is a pure, static-shaped jax kernel
+  (differentiable where the reference op has a grad kernel). roi_pool /
+  psroi_pool use a MASK formulation — bin membership is computed by
+  comparison against box coordinates, so data-dependent integer bin
+  extents never become data-dependent shapes (the XLA constraint the
+  reference's per-roi loops don't have).
+- roi_align with sampling_ratio <= 0 (adaptive grid count per roi)
+  requires a data-dependent number of sample points; under XLA that
+  is a dynamic shape, so it raises with guidance to pass an explicit
+  ratio (dead-corner-raises rule) rather than silently approximating.
+- nms produces a data-dependent-length index list: host/numpy, eager
+  only — matching the reference's CPU kernel role in the pipeline.
+"""
 from __future__ import annotations
 
+import math
+
 import numpy as np
+import jax
+import jax.numpy as jnp
 
+from ..core.engine import apply_op
 from ..core.tensor import Tensor, to_tensor
+from ..nn import Layer
+from ..nn.initializer import Constant
 
-__all__ = ["nms", "box_coder", "RoIAlign", "roi_align", "DeformConv2D"]
+__all__ = [
+    "nms", "box_coder", "iou_similarity", "prior_box", "yolo_box",
+    "roi_align", "roi_pool", "psroi_pool", "deform_conv2d",
+    "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+    "distribute_fpn_proposals",
+]
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _roi_batch_index(boxes_num, num_rois):
+    """[R] batch index per roi from per-image counts (static R)."""
+    ends = jnp.cumsum(boxes_num)
+    r = jnp.arange(num_rois)
+    return jnp.sum(r[:, None] >= ends[None, :], axis=1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# roi_align
+# ---------------------------------------------------------------------------
+
+def _k_roi_align(x, boxes, boxes_num, ph, pw, scale, ratio, aligned):
+    n, c, h, w = x.shape
+    num_rois = boxes.shape[0]
+    batch_idx = _roi_batch_index(boxes_num, num_rois)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * scale - off
+    y1 = boxes[:, 1] * scale - off
+    x2 = boxes[:, 2] * scale - off
+    y2 = boxes[:, 3] * scale - off
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+
+    gy = jnp.arange(ratio, dtype=x.dtype)
+    gx = jnp.arange(ratio, dtype=x.dtype)
+    # sample centers: y1 + (i + (iy+0.5)/ratio) * bin_h (reference
+    # roi_align_op.h get_indexes_and_ratios)
+    iy = jnp.arange(ph, dtype=x.dtype)
+    ix = jnp.arange(pw, dtype=x.dtype)
+    # [R, ph, ratio]
+    sy = (y1[:, None, None] + (iy[None, :, None]
+                               + (gy[None, None, :] + 0.5) / ratio)
+          * bin_h[:, None, None])
+    sx = (x1[:, None, None] + (ix[None, :, None]
+                               + (gx[None, None, :] + 0.5) / ratio)
+          * bin_w[:, None, None])
+
+    def bilinear(img, yy, xx):
+        """img [C,H,W]; yy/xx flat sample coords -> [C, S].
+
+        Border handling per reference roi_align_op.h: coords are
+        clamped into [0, size-1] BEFORE floor (a sample at -0.3 reads
+        row 0 with weight 1, not rows {-1, 0}), and samples outside
+        [-1, size] contribute 0."""
+        valid = ((yy >= -1.0) & (yy <= h) & (xx >= -1.0) & (xx <= w))
+        yc = jnp.clip(yy, 0.0, h - 1.0)
+        xc = jnp.clip(xx, 0.0, w - 1.0)
+        y0 = jnp.floor(yc)
+        x0 = jnp.floor(xc)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        ly = yc - y0
+        lx = xc - x0
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+               + v10 * ly * (1 - lx) + v11 * ly * lx)
+        return jnp.where(valid[None, :], out, 0.0)
+
+    def per_roi(b, sy_r, sx_r):
+        img = x[b]
+        # [ph, ratio] x [pw, ratio] grid -> flat samples
+        yy = jnp.broadcast_to(sy_r[:, None, :, None],
+                              (ph, pw, ratio, ratio)).reshape(-1)
+        xx = jnp.broadcast_to(sx_r[None, :, None, :],
+                              (ph, pw, ratio, ratio)).reshape(-1)
+        vals = bilinear(img, yy, xx)  # [C, ph*pw*ratio*ratio]
+        vals = vals.reshape(c, ph, pw, ratio * ratio)
+        return jnp.mean(vals, axis=-1)
+
+    return jax.vmap(per_roi)(batch_idx, sy, sx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference vision/ops.py:1145, roi_align_op.h)."""
+    ph, pw = _pair(output_size)
+    if sampling_ratio <= 0:
+        raise NotImplementedError(
+            "roi_align: sampling_ratio <= 0 (adaptive per-roi grid) needs "
+            "a data-dependent sample count, which XLA's static shapes "
+            "cannot express — pass an explicit sampling_ratio (2 matches "
+            "the common detector configuration)")
+    return apply_op("roi_align", _k_roi_align, x, boxes, boxes_num,
+                    ph=ph, pw=pw, scale=float(spatial_scale),
+                    ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+# ---------------------------------------------------------------------------
+# roi_pool / psroi_pool (mask formulation — exact integer-bin semantics
+# with static shapes)
+# ---------------------------------------------------------------------------
+
+def _k_roi_pool(x, boxes, boxes_num, ph, pw, scale):
+    n, c, h, w = x.shape
+    num_rois = boxes.shape[0]
+    batch_idx = _roi_batch_index(boxes_num, num_rois)
+    x1 = jnp.round(boxes[:, 0] * scale)
+    y1 = jnp.round(boxes[:, 1] * scale)
+    x2 = jnp.round(boxes[:, 2] * scale)
+    y2 = jnp.round(boxes[:, 3] * scale)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    hs = jnp.arange(h, dtype=x.dtype)
+    ws = jnp.arange(w, dtype=x.dtype)
+    i = jnp.arange(ph, dtype=x.dtype)
+    j = jnp.arange(pw, dtype=x.dtype)
+    # reference roi_pool_op.h: hstart = floor(i*bin_h)+y1 clipped,
+    # hend = ceil((i+1)*bin_h)+y1
+    hstart = jnp.clip(jnp.floor(i[None, :] * bin_h[:, None])
+                      + y1[:, None], 0, h)
+    hend = jnp.clip(jnp.ceil((i[None, :] + 1) * bin_h[:, None])
+                    + y1[:, None], 0, h)
+    wstart = jnp.clip(jnp.floor(j[None, :] * bin_w[:, None])
+                      + x1[:, None], 0, w)
+    wend = jnp.clip(jnp.ceil((j[None, :] + 1) * bin_w[:, None])
+                    + x1[:, None], 0, w)
+    # membership masks [R, ph, H], [R, pw, W]
+    hm = ((hs[None, None, :] >= hstart[:, :, None])
+          & (hs[None, None, :] < hend[:, :, None]))
+    wm = ((ws[None, None, :] >= wstart[:, :, None])
+          & (ws[None, None, :] < wend[:, :, None]))
+    # empty bins (clipped away) output 0 (reference is_empty)
+    empty = (hend <= hstart)[:, :, None] | (wend <= wstart)[:, None, :]
+
+    def per_roi(b, hm_r, wm_r, empty_r):
+        img = x[b]  # [C, H, W]
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        # separable masked max — O(pw*C*H) peak instead of the naive
+        # O(ph*pw*C*H*W) joint mask: max over W per column bin first,
+        # then over H per row bin
+        mw = jnp.where(wm_r[:, None, None, :], img[None], neg)
+        colmax = jnp.max(mw, axis=3)  # [pw, C, H]
+        mh = jnp.where(hm_r[:, None, None, :], colmax[None], neg)
+        out = jnp.max(mh, axis=3)  # [ph, pw, C]
+        out = jnp.where(empty_r[..., None], jnp.asarray(0, x.dtype), out)
+        return jnp.moveaxis(out, -1, 0)  # [C, ph, pw]
+
+    return jax.vmap(per_roi)(batch_idx, hm, wm, empty)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool (reference vision/ops.py:1022, roi_pool_op.h): max pool
+    over integer bins; empty bins output 0."""
+    ph, pw = _pair(output_size)
+    return apply_op("roi_pool", _k_roi_pool, x, boxes, boxes_num,
+                    ph=ph, pw=pw, scale=float(spatial_scale))
+
+
+def _k_psroi_pool(x, boxes, boxes_num, ph, pw, scale, out_c):
+    n, c, h, w = x.shape
+    num_rois = boxes.shape[0]
+    batch_idx = _roi_batch_index(boxes_num, num_rois)
+    # reference psroi_pool_op.h: round to integer grid then avg-pool
+    # the position-sensitive channel slice
+    x1 = jnp.round(boxes[:, 0]) * scale
+    y1 = jnp.round(boxes[:, 1]) * scale
+    x2 = jnp.round(boxes[:, 2] + 1.0) * scale
+    y2 = jnp.round(boxes[:, 3] + 1.0) * scale
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    hs = jnp.arange(h, dtype=x.dtype)
+    ws = jnp.arange(w, dtype=x.dtype)
+    i = jnp.arange(ph, dtype=x.dtype)
+    j = jnp.arange(pw, dtype=x.dtype)
+    hstart = jnp.clip(jnp.floor(i[None, :] * bin_h[:, None] + y1[:, None]),
+                      0, h)
+    hend = jnp.clip(jnp.ceil((i[None, :] + 1) * bin_h[:, None]
+                             + y1[:, None]), 0, h)
+    wstart = jnp.clip(jnp.floor(j[None, :] * bin_w[:, None] + x1[:, None]),
+                      0, w)
+    wend = jnp.clip(jnp.ceil((j[None, :] + 1) * bin_w[:, None]
+                             + x1[:, None]), 0, w)
+    hm = ((hs[None, None, :] >= hstart[:, :, None])
+          & (hs[None, None, :] < hend[:, :, None])).astype(x.dtype)
+    wm = ((ws[None, None, :] >= wstart[:, :, None])
+          & (ws[None, None, :] < wend[:, :, None])).astype(x.dtype)
+    cnt = (jnp.einsum("rih,rjw->rij", hm, wm))
+    # x reshaped so channel = out_c * (ph*pw): slice (i,j) uses channel
+    # block c_out*ph*pw ordering [out_c, ph, pw]
+    xr = x.reshape(n, out_c, ph, pw, h, w)
+
+    def per_roi(b, hm_r, wm_r, cnt_r):
+        img = xr[b]  # [out_c, ph, pw, H, W]
+        s = jnp.einsum("oijhw,ih,jw->oij", img, hm_r, wm_r)
+        return s / jnp.maximum(cnt_r[None], 1e-10)
+
+    return jax.vmap(per_roi)(batch_idx, hm, wm, cnt)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """PSRoIPool (reference vision/ops.py:911, psroi_pool_op.h):
+    position-sensitive average pooling — input channels C must equal
+    out_channels * pooled_h * pooled_w."""
+    ph, pw = _pair(output_size)
+    c = x.shape[1]
+    if c % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool: input channels {c} must be divisible by "
+            f"output_size^2 {ph * pw}")
+    return apply_op("psroi_pool", _k_psroi_pool, x, boxes, boxes_num,
+                    ph=ph, pw=pw, scale=float(spatial_scale),
+                    out_c=c // (ph * pw))
+
+
+# ---------------------------------------------------------------------------
+# yolo_box
+# ---------------------------------------------------------------------------
+
+def _k_yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample,
+                clip_bbox, scale_x_y, iou_aware, iou_aware_factor):
+    n, c, h, w = x.shape
+    an_num = len(anchors) // 2
+    bias = -0.5 * (scale_x_y - 1.0)
+    if iou_aware:
+        ious = x[:, :an_num].reshape(n, an_num, 1, h, w)
+        px = x[:, an_num:].reshape(n, an_num, 5 + class_num, h, w)
+    else:
+        px = x.reshape(n, an_num, 5 + class_num, h, w)
+    anchors_a = jnp.asarray(anchors, x.dtype).reshape(an_num, 2)
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    grid_x = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    cx = (grid_x + sig(px[:, :, 0]) * scale_x_y + bias) * img_w / w
+    cy = (grid_y + sig(px[:, :, 1]) * scale_x_y + bias) * img_h / h
+    bw = (jnp.exp(px[:, :, 2]) * anchors_a[None, :, 0, None, None]
+          * img_w / (downsample * w))
+    bh = (jnp.exp(px[:, :, 3]) * anchors_a[None, :, 1, None, None]
+          * img_h / (downsample * h))
+    conf = sig(px[:, :, 4])
+    if iou_aware:
+        iou = sig(ious[:, :, 0])
+        conf = (conf ** (1.0 - iou_aware_factor)) * (
+            iou ** iou_aware_factor)
+    keep = conf >= conf_thresh
+    x1 = cx - bw / 2
+    y1 = cy - bh / 2
+    x2 = cx + bw / 2
+    y2 = cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.maximum(x1, 0.0)
+        y1 = jnp.maximum(y1, 0.0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=2)  # [N, an, 4, H, W]
+    boxes = jnp.where(keep[:, :, None], boxes, 0.0)
+    scores = conf[:, :, None] * sig(px[:, :, 5:])
+    scores = jnp.where(keep[:, :, None], scores, 0.0)
+    # layout [N, an*H*W, ...] with an-major then hw (reference box_idx =
+    # (i*box_num + j*stride + k*w + l))
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, an_num * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+        n, an_num * h * w, class_num)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """YOLOv3 box decoder (reference vision/ops.py:252,
+    yolo_box_op.h GetYoloBox). Returns (boxes [N,B,4], scores
+    [N,B,class_num]); predictions under conf_thresh are zeroed."""
+    return apply_op(
+        "yolo_box", _k_yolo_box, x, img_size,
+        anchors=tuple(int(a) for a in anchors), class_num=int(class_num),
+        conf_thresh=float(conf_thresh), downsample=int(downsample_ratio),
+        clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y),
+        iou_aware=bool(iou_aware),
+        iou_aware_factor=float(iou_aware_factor))
+
+
+# ---------------------------------------------------------------------------
+# prior_box / box_coder / iou_similarity
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (reference detection.py:1771,
+    prior_box_op.h). Returns (boxes [H,W,num_priors,4], variances same
+    shape)."""
+    min_sizes = [float(s) for s in (min_sizes if isinstance(
+        min_sizes, (list, tuple)) else [min_sizes])]
+    max_sizes = [float(s) for s in (max_sizes or [])]
+    ars = _expand_aspect_ratios(list(aspect_ratios), flip)
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    def _k(_x):
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+        whs = []  # ordered (w, h) per prior
+        for s, mn in enumerate(min_sizes):
+            if min_max_aspect_ratios_order:
+                whs.append((mn / 2.0, mn / 2.0))
+                if max_sizes:
+                    m = math.sqrt(mn * max_sizes[s]) / 2.0
+                    whs.append((m, m))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((mn * math.sqrt(ar) / 2.0,
+                                mn / math.sqrt(ar) / 2.0))
+            else:
+                for ar in ars:
+                    whs.append((mn * math.sqrt(ar) / 2.0,
+                                mn / math.sqrt(ar) / 2.0))
+                if max_sizes:
+                    m = math.sqrt(mn * max_sizes[s]) / 2.0
+                    whs.append((m, m))
+        wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+        ctr = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)
+        # boxes [H, W, P, 4] normalized by image size
+        b = jnp.stack([
+            (ctr[..., 1:2] - wh[None, None, :, 0]) / iw,
+            (ctr[..., 0:1] - wh[None, None, :, 1]) / ih,
+            (ctr[..., 1:2] + wh[None, None, :, 0]) / iw,
+            (ctr[..., 0:1] + wh[None, None, :, 1]) / ih,
+        ], axis=-1)
+        if clip:
+            b = jnp.clip(b, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               b.shape)
+        return b, var
+
+    return apply_op("prior_box", _k, input)
+
+
+def _k_box_coder(prior, pvar, target, code_type, normalized, axis,
+                 variance):
+    norm = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + norm
+    ph_ = prior[:, 3] - prior[:, 1] + norm
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph_ / 2
+    if code_type == "encode_center_size":
+        # target [R,4] x prior [C,4] -> [R, C, 4]
+        tw = target[:, 2] - target[:, 0] + norm
+        th = target[:, 3] - target[:, 1] + norm
+        tcx = (target[:, 2] + target[:, 0]) / 2
+        tcy = (target[:, 3] + target[:, 1]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph_[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance, out.dtype)
+        return out
+    # decode_center_size: target [R, C, 4]; prior along `axis`
+    if pvar is not None:
+        var = pvar[None, :, :] if axis == 0 else pvar[:, None, :]
+    elif variance:
+        var = jnp.asarray(variance, target.dtype).reshape(1, 1, 4)
+    else:
+        var = jnp.ones((1, 1, 4), target.dtype)
+    if axis == 0:
+        pw_b, ph_b = pw[None, :], ph_[None, :]
+        pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+    else:
+        pw_b, ph_b = pw[:, None], ph_[:, None]
+        pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+    tcx = var[..., 0] * target[..., 0] * pw_b + pcx_b
+    tcy = var[..., 1] * target[..., 1] * ph_b + pcy_b
+    tw = jnp.exp(var[..., 2] * target[..., 2]) * pw_b
+    th = jnp.exp(var[..., 3] * target[..., 3]) * ph_b
+    return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                      tcx + tw / 2 - norm, tcy + th / 2 - norm], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference detection.py:819,
+    box_coder_op.h EncodeCenterSize/DecodeCenterSize)."""
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(f"box_coder: bad code_type {code_type!r}")
+    variance = None
+    pvar = prior_box_var
+    if isinstance(prior_box_var, (list, tuple)):
+        variance = [float(v) for v in prior_box_var]
+        pvar = None
+    return apply_op("box_coder", _k_box_coder, prior_box, pvar,
+                    target_box, code_type=code_type,
+                    normalized=bool(box_normalized), axis=int(axis),
+                    variance=tuple(variance) if variance else ())
+
+
+def _k_iou_similarity(a, b, normalized):
+    norm = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + norm) * (a[:, 3] - a[:, 1] + norm)
+    area_b = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = (jnp.maximum(x2 - x1 + norm, 0.0)
+             * jnp.maximum(y2 - y1 + norm, 0.0))
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(inter > 0, inter / union, 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU [N,M] (reference detection.py:765,
+    iou_similarity_op.h)."""
+    return apply_op("iou_similarity", _k_iou_similarity, x, y,
+                    normalized=bool(box_normalized))
+
+
+# ---------------------------------------------------------------------------
+# deform_conv2d (v1/v2)
+# ---------------------------------------------------------------------------
+
+def _k_deform_conv2d(x, offset, mask, weight, bias, stride, padding,
+                     dilation, dg, groups):
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph_, pw_ = padding
+    dh, dw = dilation
+    hout = (h + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    wout = (w + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+    # base sampling positions per output pixel and kernel tap
+    oy = jnp.arange(hout) * sh - ph_
+    ox = jnp.arange(wout) * sw - pw_
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = (oy[:, None, None, None] + ky[None, None, :, None]
+              ).astype(x.dtype)  # [Ho,1,kh,1]
+    base_x = (ox[None, :, None, None] + kx[None, None, None, :]
+              ).astype(x.dtype)  # [1,Wo,1,kw]
+    # offset: [N, dg*2*kh*kw, Ho, Wo] (reference layout: per group,
+    # (y, x) interleaved per tap)
+    off = offset.reshape(n, dg, kh * kw, 2, hout, wout)
+    off_y = off[:, :, :, 0].reshape(n, dg, kh, kw, hout, wout)
+    off_x = off[:, :, :, 1].reshape(n, dg, kh, kw, hout, wout)
+    if mask is not None:
+        mk = mask.reshape(n, dg, kh, kw, hout, wout)
+    else:
+        mk = None
+
+    cpg = cin // dg  # channels per deformable group
+
+    def sample_group(img_g, oy_g, ox_g, mk_g):
+        """img_g [cpg,H,W]; oy/ox [kh,kw,Ho,Wo] -> [cpg,kh,kw,Ho,Wo]."""
+        yy = (base_y.transpose(2, 3, 0, 1) + oy_g)  # [kh,kw,Ho,Wo]
+        xx = (base_x.transpose(2, 3, 0, 1) + ox_g)
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        ly = yy - y0
+        lx = xx - x0
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+
+        def gather(yi, xi):
+            inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            v = img_g[:, yc, xc]  # [cpg, kh,kw,Ho,Wo]
+            return jnp.where(inb[None], v, 0.0)
+
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        out = (v00 * ((1 - ly) * (1 - lx))[None]
+               + v01 * ((1 - ly) * lx)[None]
+               + v10 * (ly * (1 - lx))[None]
+               + v11 * (ly * lx)[None])
+        # zero out taps whose sample center fell fully outside
+        valid = (yy > -1) & (yy < h) & (xx > -1) & (xx < w)
+        out = jnp.where(valid[None], out, 0.0)
+        if mk_g is not None:
+            out = out * mk_g[None]
+        return out
+
+    def per_image(img, oy_i, ox_i, mk_i):
+        groups_out = []
+        for g in range(dg):
+            img_g = jax.lax.dynamic_slice_in_dim(img, g * cpg, cpg, 0)
+            mk_g = mk_i[g] if mk_i is not None else None
+            groups_out.append(sample_group(img_g, oy_i[g], ox_i[g], mk_g))
+        return jnp.concatenate(groups_out, axis=0)  # [cin,kh,kw,Ho,Wo]
+
+    if mk is not None:
+        cols = jax.vmap(per_image)(x, off_y, off_x, mk)
+    else:
+        cols = jax.vmap(lambda img, oy_i, ox_i: per_image(
+            img, oy_i, ox_i, None))(x, off_y, off_x)
+    # conv as grouped GEMM over the sampled columns: weight
+    # [cout, cin/groups, kh, kw], cols [N, cin, kh, kw, Ho, Wo]
+    cg = cin // groups
+    og = cout // groups
+    outs = []
+    for g in range(groups):
+        cols_g = cols[:, g * cg:(g + 1) * cg]
+        w_g = weight[g * og:(g + 1) * og]
+        outs.append(jnp.einsum("nckxhw,ockx->nohw", cols_g, w_g))
+    out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (reference
+    vision/ops.py:423, deformable_conv_op.h): bilinear sampling at
+    offset tap positions, then a grouped GEMM over the sampled columns
+    (im2col with learned coordinates — MXU-friendly)."""
+    return apply_op("deform_conv2d", _k_deform_conv2d, x, offset, mask,
+                    weight, bias, stride=_pair(stride),
+                    padding=_pair(padding), dilation=_pair(dilation),
+                    dg=int(deformable_groups), groups=int(groups))
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._dg = deformable_groups
+        self._groups = groups
+        k = 1.0 / math.sqrt(in_channels * kh * kw)
+        from ..nn.initializer import Uniform
+
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr, default_initializer=Uniform(-k, k))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr,
+                default_initializer=Constant(0.0), is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._dg, self._groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# layers + remaining host-side ops
+# ---------------------------------------------------------------------------
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, sampling_ratio=2,
+                         aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
-    b = np.asarray(boxes._value, np.float32)
-    s = (np.asarray(scores._value, np.float32) if scores is not None
+    """Greedy NMS (reference vision/ops.py nms): host/numpy — the kept
+    index list is data-dependent-length, so this is an eager-only op
+    like the reference CPU kernel."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes,
+                   np.float32)
+    s = (np.asarray(scores._value if isinstance(scores, Tensor)
+                    else scores, np.float32) if scores is not None
          else np.ones(len(b), np.float32))
-    order = np.argsort(-s)
-    keep = []
-    suppressed = np.zeros(len(b), bool)
-    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    for i in order:
-        if suppressed[i]:
-            continue
-        keep.append(int(i))
-        xx1 = np.maximum(b[i, 0], b[:, 0])
-        yy1 = np.maximum(b[i, 1], b[:, 1])
-        xx2 = np.minimum(b[i, 2], b[:, 2])
-        yy2 = np.minimum(b[i, 3], b[:, 3])
-        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
-        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
-        suppressed |= iou > iou_threshold
-        suppressed[i] = True
+    cat = (np.asarray(category_idxs._value
+                      if isinstance(category_idxs, Tensor)
+                      else category_idxs)
+           if category_idxs is not None else None)
+
+    def _nms_single(idxs):
+        order = idxs[np.argsort(-s[idxs])]
+        keep = []
+        suppressed = np.zeros(len(b), bool)
+        areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(int(i))
+            xx1 = np.maximum(b[i, 0], b[idxs, 0])
+            yy1 = np.maximum(b[i, 1], b[idxs, 1])
+            xx2 = np.minimum(b[i, 2], b[idxs, 2])
+            yy2 = np.minimum(b[i, 3], b[idxs, 3])
+            inter = (np.maximum(xx2 - xx1, 0)
+                     * np.maximum(yy2 - yy1, 0))
+            iou = inter / np.maximum(areas[i] + areas[idxs] - inter,
+                                     1e-10)
+            suppressed[idxs[iou > iou_threshold]] = True
+            suppressed[i] = True
+        return keep
+
+    if cat is None:
+        keep = _nms_single(np.arange(len(b)))
+    else:
+        keep = []
+        for c in (categories if categories is not None
+                  else np.unique(cat)):
+            keep.extend(_nms_single(np.where(cat == c)[0]))
+        keep = sorted(keep, key=lambda i: -s[i])
     if top_k is not None:
         keep = keep[:top_k]
     return to_tensor(np.asarray(keep, np.int64))
 
 
-def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0, name=None):
-    raise NotImplementedError("box_coder: planned (detection suite)")
-
-
-def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True, name=None):
-    raise NotImplementedError("roi_align: planned (detection suite)")
-
-
-class RoIAlign:
-    def __init__(self, output_size, spatial_scale=1.0):
-        raise NotImplementedError("RoIAlign: planned (detection suite)")
-
-
-class DeformConv2D:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("DeformConv2D: planned (detection suite)")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals_op.h). Host/numpy (output row counts are
+    data-dependent), eager only."""
+    rois = np.asarray(fpn_rois._value if isinstance(fpn_rois, Tensor)
+                      else fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        # per-image counts -> per-level, per-image counts (reference
+        # MultiLevelRoIsNum outputs), so each level's output can feed
+        # roi_align's boxes_num with image boundaries intact
+        rn = np.asarray(rois_num._value if isinstance(rois_num, Tensor)
+                        else rois_num).astype(np.int64)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+    else:
+        img_of = np.zeros(len(rois), np.int64)
+        rn = np.asarray([len(rois)], np.int64)
+    outs, restore = [], np.empty(len(rois), np.int64)
+    nums = []
+    pos = 0
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        # stable by image so per-image counts describe contiguous rows
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
+        outs.append(to_tensor(rois[idx]))
+        nums.append(to_tensor(np.bincount(
+            img_of[idx], minlength=len(rn)).astype(np.int32)))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+    return outs, to_tensor(restore), nums
