@@ -474,10 +474,13 @@ def nonzero(x, as_tuple=False):
     return to_tensor(np.stack(idx, axis=1).astype(np.int64))
 
 
+_py_slice = slice  # the builtin — shadowed by the paddle `slice` op
+
+
 def _k_slice(x, starts, ends, axes):
-    idx = [slice(None)] * x.ndim
+    idx = [_py_slice(None)] * x.ndim
     for ax, s, e in zip(axes, starts, ends):
-        idx[ax] = slice(s, e)
+        idx[ax] = _py_slice(s, e)
     return x[tuple(idx)]
 
 
@@ -493,9 +496,9 @@ def slice(x, axes, starts, ends):
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
     def _k(v, axes, starts, ends, strides):
-        idx = [slice(None)] * v.ndim
+        idx = [_py_slice(None)] * v.ndim
         for ax, s, e, st in zip(axes, starts, ends, strides):
-            idx[ax] = slice(s, e, st)
+            idx[ax] = _py_slice(s, e, st)
         return v[tuple(idx)]
 
     return apply_op("strided_slice", _k, x, axes=tuple(axes),
@@ -507,7 +510,7 @@ def crop(x, shape=None, offsets=None, name=None):
     shape = _shape_arg(shape)
     offsets = tuple(int(o) for o in (offsets or [0] * x.ndim))
     def _k(v, shape, offsets):
-        idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+        idx = tuple(_py_slice(o, o + s) for o, s in zip(offsets, shape))
         return v[idx]
 
     return apply_op("crop", _k, x, shape=shape, offsets=offsets)

