@@ -28,7 +28,9 @@ from ..ops import random as _random
 from . import state as _jstate
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TracedLayer",
-           "TrainStepCompiler", "InputSpec"]
+           "TrainStepCompiler", "InputSpec", "set_max_loop_iterations"]
+
+from .dy2static import set_max_loop_iterations  # noqa: E402
 
 
 class InputSpec:
@@ -173,10 +175,17 @@ class StaticFunction:
         static_leaves = [None if isinstance(a, Tensor) else a
                          for a in flat_args]
 
+        from .dy2static import max_loop_iterations
+
         key = (args_treedef, tuple(tensor_pos),
                tuple((tuple(flat_args[i].shape), str(flat_args[i].dtype))
                      for i in tensor_pos), tuple(param_ids),
-               tuple(_freeze_static(v) for v in static_leaves))
+               tuple(_freeze_static(v) for v in static_leaves),
+               # the loop bound changes the lowering (while_loop vs
+               # bounded scan) — it must participate in the cache key
+               # or a later set_max_loop_iterations() silently reuses
+               # the stale compiled program
+               max_loop_iterations())
         entry = self._compiled.get(key)
         if entry is None:
             entry = self._build(target, params, args_treedef, tensor_pos,

