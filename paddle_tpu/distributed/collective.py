@@ -14,6 +14,10 @@ TPU-native design, two execution regimes:
    (JAX's single-controller view), so cross-replica collectives are
    identity/reduction no-ops by construction — matching the semantics
    the reference achieves with NCCL calls, without per-op comm.
+3. Eager MULTI-process: world-group collectives ride
+   multihost_utils (gloo); rank-subset groups and p2p ride the TCP KV
+   store (store_collective.py — the reference's gloo-store path), so
+   `new_group(ranks)` works eagerly with only members calling.
 """
 from __future__ import annotations
 
@@ -75,19 +79,61 @@ def new_group(ranks=None, backend=None, timeout=None):
     return new_group_for_axes((), ranks=ranks or [])
 
 
-def _require_world_group(group, opname):
-    """Multi-process eager collectives run over the WORLD: the mhu
-    primitives are global barriers, so a rank-subset group — where the
-    reference convention is that only members call — would deadlock
-    (members wait on non-members forever). Refuse loudly; subgroup
-    collectives belong inside compiled steps (mesh-axis groups)."""
-    if (group is not None and group.ranks
-            and len(group.ranks) < jax.process_count()):
-        raise NotImplementedError(
-            f"paddle.distributed.{opname}: eager rank-subset groups are "
-            "not supported across processes (global-barrier transport) "
-            "— run subgroup collectives inside a compiled step over a "
-            "mesh axis, or use the world group")
+def _nprocs():
+    """World size for eager dispatch: jax.distributed when live, else
+    the PADDLE launch env contract — the store-backed paths have no
+    dependency on jax's coordination service, so they work (and are
+    testable) without it."""
+    import os
+
+    n = jax.process_count()
+    if n > 1:
+        return n
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def _proc_index():
+    import os
+
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def _is_subgroup(group):
+    return (group is not None and group.ranks
+            and len(group.ranks) < _nprocs())
+
+
+_store_comms: dict = {}
+
+
+def _store_comm(group):
+    """Store-backed communicator for an eager rank-subset group: only
+    MEMBERS call, peers exchange through the TCP KV store (the gloo
+    store analog — see store_collective.py). Cached per rank list, the
+    multi-ring registry pattern (collective_helper.h:71)."""
+    ranks = (list(group.ranks) if group is not None and group.ranks
+             else list(range(_nprocs())))
+    # sorted: StoreGroupComm's tag sorts ranks, so [0,2] and [2,0] are
+    # the SAME channel — they must share one sequence counter
+    key = tuple(sorted(int(r) for r in ranks))
+    c = _store_comms.get(key)
+    if c is None:
+        from .store_collective import StoreGroupComm
+
+        c = StoreGroupComm(ranks, _proc_index())
+        _store_comms[key] = c
+    return c
+
+
+_REDUCE_NAMES = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+                 ReduceOp.MIN: "min", ReduceOp.PROD: "prod",
+                 ReduceOp.AVG: "avg"}
+# single source of truth for the world-group eager reducers — keyed by
+# the same names the store path uses, so the two cannot drift
+_JNP_REDUCERS = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                 "prod": jnp.prod, "avg": jnp.mean}
 
 
 def _reduce_in_trace(v, op, axes):
@@ -129,25 +175,30 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         tensor._node = out._node
         tensor._out_index = out._out_index
         return tensor
-    if jax.process_count() > 1:
+    if _nprocs() > 1:
         # multi-process eager: each controller holds only its local
         # data — a REAL cross-process reduction is required (VERDICT
         # r1 weak #10: the single-controller identity would be
-        # silently wrong here). World group only (see
-        # _require_world_group).
+        # silently wrong here)
         from jax.experimental import multihost_utils as mhu
 
-        _require_world_group(group, "all_reduce")
-        reds = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
-                ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
-                ReduceOp.AVG: jnp.mean}
-        if op not in reds:
+        if op not in _REDUCE_NAMES:
             raise ValueError(
                 f"paddle.distributed.all_reduce: unsupported ReduceOp "
                 f"{op!r}")
-        gathered = mhu.process_allgather(
-            tensor._value if isinstance(tensor, Tensor) else tensor)
-        result = reds[op](gathered, axis=0)
+        if _is_subgroup(group) or jax.process_count() == 1:
+            # rank-subset group — or env-only dispatch (PADDLE env set
+            # but jax.distributed not initialized, where the mhu path
+            # would silently return LOCAL-only results): exchange
+            # through the TCP store (gloo-path analog)
+            val = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                             else tensor)
+            result = jnp.asarray(
+                _store_comm(group).all_reduce(val, _REDUCE_NAMES[op]))
+        else:
+            gathered = mhu.process_allgather(
+                tensor._value if isinstance(tensor, Tensor) else tensor)
+            result = _JNP_REDUCERS[_REDUCE_NAMES[op]](gathered, axis=0)
         if isinstance(tensor, Tensor):
             tensor._value = result
             return tensor
@@ -200,13 +251,17 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         tensor._node = out._node
         tensor._out_index = out._out_index
         return tensor
-    if jax.process_count() > 1:
+    if _nprocs() > 1:
         from jax.experimental import multihost_utils as mhu
 
-        _require_world_group(group, "broadcast")
-        result = mhu.broadcast_one_to_all(
-            tensor._value if isinstance(tensor, Tensor) else tensor,
-            is_source=jax.process_index() == src)
+        if _is_subgroup(group) or jax.process_count() == 1:
+            val = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                             else tensor)
+            result = jnp.asarray(_store_comm(group).broadcast(val, src))
+        else:
+            result = mhu.broadcast_one_to_all(
+                tensor._value if isinstance(tensor, Tensor) else tensor,
+                is_source=_proc_index() == src)
         if isinstance(tensor, Tensor):
             tensor._value = result
             return tensor
@@ -233,10 +288,17 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         parts = unstack(out, axis=0)
         tensor_list.extend(parts)
         return tensor_list
-    if jax.process_count() > 1:
+    if _nprocs() > 1:
         from jax.experimental import multihost_utils as mhu
 
-        _require_world_group(group, "all_gather")
+        if _is_subgroup(group) or jax.process_count() == 1:
+            val = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                             else tensor)
+            parts = _store_comm(group).all_gather(val)
+            tensor_list.extend(
+                Tensor(jnp.asarray(p), stop_gradient=True,
+                       _internal=True) for p in parts)
+            return tensor_list
         gathered = mhu.process_allgather(
             tensor._value if isinstance(tensor, Tensor) else tensor)
         tensor_list.extend(
@@ -354,11 +416,18 @@ def send(tensor, dst=0, group=None, sync_op=True):
             del _pending_sends[ax]  # stale entry from an aborted trace
         _pending_sends[ax] = (int(dst), tensor, lax.axis_index(ax))
         return tensor
+    if _nprocs() > 1:
+        # eager cross-process p2p: sequenced edge keys on the TCP
+        # store (send_v2 analog over the gloo-store transport)
+        val = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                         else tensor)
+        _store_comm(group or world_group()).send(val, dst)
+        return tensor
     raise NotImplementedError(
-        "paddle.distributed.send: eager point-to-point is not supported "
-        "under the single-controller runtime — use the pipeline schedule "
-        "(PipelineParallel / GPTConfig.pp_num_stages) or call send/recv "
-        "inside a compiled step where the pair lowers to collective-permute")
+        "paddle.distributed.send: single-process eager point-to-point "
+        "has no peer — use the pipeline schedule (PipelineParallel / "
+        "GPTConfig.pp_num_stages) or call send/recv inside a compiled "
+        "step where the pair lowers to collective-permute")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
@@ -394,13 +463,38 @@ def recv(tensor, src=0, group=None, sync_op=True):
             tensor._node = out._node
             tensor._out_index = out._out_index
         return out
+    if _nprocs() > 1:
+        val = _store_comm(group or world_group()).recv(src)
+        result = jnp.asarray(val)
+        if isinstance(tensor, Tensor):
+            tensor._value = result
+            return tensor
+        return Tensor(result, stop_gradient=True, _internal=True)
     raise NotImplementedError(
-        "paddle.distributed.recv: eager point-to-point is not supported "
-        "under the single-controller runtime — see send()")
+        "paddle.distributed.recv: single-process eager point-to-point "
+        "has no peer — see send()")
 
 
 def barrier(group=None):
-    """barrier op analog — drain device queue."""
+    """barrier op analog. Multi-process eager: a real cross-process
+    rendezvous through the TCP store (reference barrier op over gloo)
+    — crucially this keeps rank 0 (the store host) alive until every
+    member arrives, so peers mid-collective never lose the transport.
+    Single process: drain the device queue."""
+    if _nprocs() > 1 and not in_trace_mode():
+        from .store_collective import store_endpoint
+
+        if store_endpoint() is not None:
+            _store_comm(group if (group is not None and group.ranks)
+                        else None).barrier()
+            return
+        if jax.process_count() > 1:
+            # jax-native multi-process without the PADDLE launch env
+            # (e.g. a plain TPU pod): ride the coordination service
+            from jax.experimental import multihost_utils as mhu
+
+            mhu.sync_global_devices("paddle_distributed_barrier")
+            return
     (jax.device_put(0.0) + 0).block_until_ready()
 
 

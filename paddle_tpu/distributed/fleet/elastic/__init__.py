@@ -136,7 +136,11 @@ class KVClient:
         self._addr = (host, int(port))
         self._timeout = timeout
         self._sock = None
-        self._lock = threading.Lock()
+        # RLock: _call's error path invokes close() while already
+        # holding the lock — a plain Lock self-deadlocks there, turning
+        # every transient connect failure (e.g. probing a store that
+        # hasn't bound yet) into a permanent hang
+        self._lock = threading.RLock()
 
     def _conn(self):
         if self._sock is None:

@@ -17,10 +17,15 @@ processes (the PS TCP transport as the brpc-analog message bus).
 """
 from __future__ import annotations
 
+import pickle
 import queue
+import socket
+import socketserver
+import struct
 import threading
 
-__all__ = ["TaskNode", "Carrier", "FleetExecutor"]
+__all__ = ["TaskNode", "Carrier", "FleetExecutor", "MessageBus",
+           "DistFleetExecutor"]
 
 
 class TaskNode:
@@ -75,10 +80,10 @@ class _Interceptor(threading.Thread):
             self._credits[src].release()
         return m
 
-    def _emit_stop(self):
+    def _emit_stop(self, err=None):
         for down in self.node.downstream:
-            self.carrier.interceptors[down.name].post(
-                self.node.name, self.carrier.STOP)
+            self.carrier.route(down.name, self.node.name,
+                               self.carrier.STOP, err=err)
         self.carrier.outputs[self.node.name].put(self.carrier.STOP)
 
     def _drain(self, open_srcs):
@@ -108,21 +113,24 @@ class _Interceptor(threading.Thread):
                 # the joined stream ends when ANY upstream ends; emit
                 # STOP FIRST (unblocks downstream), then drain the
                 # other upstreams' in-flight messages (documented join
-                # semantics) so producers never block
-                self._emit_stop()
+                # semantics) so producers never block. Forward any
+                # recorded failure cause so multi-hop remote sinks
+                # still learn the stream ended in error.
+                cause = (self.carrier.errors[0][1]
+                         if self.carrier.errors else None)
+                self._emit_stop(err=cause)
                 self._drain(open_srcs)
                 return
             try:
                 out = self.node.fn(*args)
             except Exception as e:  # surface once, poison, drain
                 self.carrier.errors.append((self.node.name, e))
-                self._emit_stop()
+                self._emit_stop(err=e)  # remote ranks learn the cause
                 self._drain(open_srcs)
                 return
             n_done += 1
             for down in self.node.downstream:
-                self.carrier.interceptors[down.name].post(
-                    self.node.name, out)
+                self.carrier.route(down.name, self.node.name, out)
             if not self.node.downstream:
                 self.carrier.outputs[self.node.name].put(out)
             if (self.node.max_run_times is not None
@@ -138,7 +146,12 @@ class Carrier:
 
     STOP = object()
 
-    def __init__(self, nodes):
+    def __init__(self, nodes, bus=None, placement=None, rank=0):
+        """`nodes` is the FULL graph (wiring complete on every rank).
+        With a `placement` map (node name -> rank) and a MessageBus,
+        this carrier instantiates interceptors only for ITS rank's
+        nodes; cross-rank edges route through the bus (the reference's
+        brpc MessageBus, carrier.h:49 "cross-rank is the point")."""
         self.nodes = list(nodes)
         names = [n.name for n in self.nodes]
         dupes = {n for n in names if names.count(n) > 1}
@@ -147,11 +160,46 @@ class Carrier:
                 f"duplicate TaskNode names {sorted(dupes)} — routing is "
                 "name-keyed; pass name= to TaskNode (lambdas all "
                 "default to '<lambda>')")
+        self.bus = bus
+        self.rank = rank
+        self.placement = placement or {n.name: rank for n in self.nodes}
+        local = [n for n in self.nodes
+                 if self.placement.get(n.name, rank) == rank]
         self.interceptors = {}
-        self.outputs = {n.name: queue.Queue() for n in self.nodes}
+        self.outputs = {n.name: queue.Queue() for n in local}
         self.errors = []
-        for n in self.nodes:
+        for n in local:
             self.interceptors[n.name] = _Interceptor(n, self)
+        if bus is not None:
+            bus.bind_carrier(self)
+
+    def route(self, dst_name, src_name, msg, err=None):
+        """Deliver to a local interceptor or ship over the bus. `err`
+        rides along with STOP so remote ranks learn WHY the stream
+        ended (a bare STOP would make a failure look like clean
+        completion downstream)."""
+        it = self.interceptors.get(dst_name)
+        if it is not None:
+            # local delivery: the failing interceptor already recorded
+            # the error in THIS carrier's errors list
+            it.post(src_name, msg)
+            return
+        if self.bus is None:
+            raise RuntimeError(
+                f"node {dst_name!r} is not local and no MessageBus is "
+                "attached")
+        self.bus.send(self.placement[dst_name], dst_name, src_name,
+                      None if msg is self.STOP else msg,
+                      is_stop=msg is self.STOP,
+                      err=repr(err) if err is not None else None)
+
+    def deliver(self, dst_name, src_name, value, is_stop, err=None):
+        """Bus entry point (remote message arrived)."""
+        if err is not None:
+            self.errors.append(
+                (src_name, RuntimeError(f"remote task failed: {err}")))
+        self.interceptors[dst_name].post(
+            src_name, self.STOP if is_stop else value)
 
     def start(self):
         for it in self.interceptors.values():
@@ -163,7 +211,7 @@ class Carrier:
 
     def stop_feeds(self):
         for n in self.nodes:
-            if not n.upstream:
+            if not n.upstream and n.name in self.interceptors:
                 self.interceptors[n.name].post("__feed__", self.STOP)
 
     def collect(self, node_name):
@@ -183,6 +231,182 @@ class Carrier:
         for it in self.interceptors.values():
             it.join(timeout)
         return self
+
+
+class MessageBus:
+    """TCP message bus between carriers (the brpc MessageBus analog,
+    fleet_executor/message_bus.cc): each rank listens on its endpoint;
+    messages are length-prefixed pickled (dst_node, src_node, value,
+    is_stop) frames. Receiving applies the destination interceptor's
+    normal credit discipline — backpressure extends across the wire
+    because the reader thread blocks on a full inbox."""
+
+    def __init__(self, rank, endpoints):
+        self.rank = int(rank)
+        self.endpoints = list(endpoints)
+        self._carrier = None
+        self._conns = {}       # dst_rank -> (socket, per-dest lock)
+        self._dict_lock = threading.Lock()
+        host, port = self.endpoints[self.rank].rsplit(":", 1)
+        bus = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                f = self.request.makefile("rb")
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        return
+                    (n,) = struct.unpack("!I", hdr)
+                    frame = f.read(n)
+                    try:
+                        dst, src, value, is_stop, err = \
+                            pickle.loads(frame)
+                    except Exception as e:  # undecodable frame: log,
+                        # keep the stream alive for later frames
+                        import sys
+
+                        print(f"[fleet_executor bus rank {bus.rank}] "
+                              f"dropping undecodable frame: {e!r}",
+                              file=sys.stderr)
+                        continue
+                    try:
+                        bus._carrier.deliver(dst, src, value, is_stop,
+                                             err)
+                    except Exception as e:  # delivery failure (e.g. a
+                        # placement mismatch -> no such local node) is
+                        # an ERROR, not a droppable frame: record it so
+                        # collect() raises instead of hanging silently
+                        bus._carrier.errors.append((f"bus:{dst}", e))
+                        import sys
+
+                        print(f"[fleet_executor bus rank {bus.rank}] "
+                              f"cannot deliver to {dst!r}: {e!r}",
+                              file=sys.stderr)
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        # bind (so peers' connect-retries succeed) but do NOT serve
+        # until a carrier is attached — a frame arriving before
+        # bind_carrier would hit _carrier=None
+        self._server = Srv((host, int(port)), Handler)
+        self._serving = False
+
+    def bind_carrier(self, carrier):
+        self._carrier = carrier
+        if not self._serving:
+            self._serving = True
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+
+    def _conn_for(self, dst_rank):
+        with self._dict_lock:
+            ent = self._conns.get(dst_rank)
+            if ent is not None:
+                return ent
+            lock = threading.Lock()
+            self._conns[dst_rank] = (None, lock)
+        host, port = self.endpoints[dst_rank].rsplit(":", 1)
+        import time as _time
+
+        t0 = _time.time()
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)),
+                                             timeout=10)
+                break
+            except OSError:
+                if _time.time() - t0 > 30.0:
+                    # do NOT leave the (None, lock) placeholder behind:
+                    # it would make every future send skip connecting
+                    # and time out even after the peer comes up
+                    with self._dict_lock:
+                        if self._conns.get(dst_rank) == (None, lock):
+                            del self._conns[dst_rank]
+                    raise
+                _time.sleep(0.05)
+        with self._dict_lock:
+            self._conns[dst_rank] = (s, lock)
+        return s, lock
+
+    def send(self, dst_rank, dst_node, src_node, value, is_stop=False,
+             err=None):
+        payload = pickle.dumps((dst_node, src_node, value, is_stop,
+                                err))
+        s, lock = self._conn_for(dst_rank)
+        if s is None:  # another thread is still connecting
+            import time as _time
+
+            t0 = _time.time()
+            while s is None:
+                if _time.time() - t0 > 30.0:
+                    raise TimeoutError(
+                        f"bus connection to rank {dst_rank} not ready")
+                _time.sleep(0.01)
+                with self._dict_lock:
+                    s, lock = self._conns[dst_rank]
+        # per-destination lock: a slow/backpressured peer must not
+        # stall sends to every OTHER rank (the old single global lock
+        # could deadlock fan-out graphs)
+        with lock:
+            s.sendall(struct.pack("!I", len(payload)) + payload)
+
+    def close(self):
+        with self._dict_lock:
+            for s, _ in self._conns.values():
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._conns.clear()
+        if self._serving:
+            self._server.shutdown()
+        self._server.server_close()
+
+
+class DistFleetExecutor:
+    """Cross-process FleetExecutor (fleet_executor.cc over brpc): every
+    rank constructs the SAME full graph and a placement map; each rank
+    runs its slice, with cross-rank edges on the TCP bus. Source ranks
+    call run_source(feeds); sink ranks call collect_sink()."""
+
+    def __init__(self, nodes, placement, rank, endpoints):
+        self.bus = MessageBus(rank, endpoints)
+        self.carrier = Carrier(nodes, bus=self.bus,
+                               placement=placement, rank=rank)
+        self.carrier.start()
+        self.rank = rank
+
+    def run_source(self, node_name, feeds):
+        for f in feeds:
+            self.carrier.feed(node_name, f)
+        self.carrier.interceptors[node_name].post(
+            "__feed__", self.carrier.STOP)
+
+    def collect_sink(self, node_name):
+        return list(self.carrier.collect(node_name))
+
+    def shutdown(self):
+        self.carrier.wait(timeout=10)
+        still = [name for name, it in self.carrier.interceptors.items()
+                 if it.is_alive()]
+        if still:
+            # closing the bus under a live interceptor kills it
+            # mid-send with no STOP downstream — give stragglers a
+            # real grace period and warn if they persist
+            self.carrier.wait(timeout=60)
+            still = [n for n, it in self.carrier.interceptors.items()
+                     if it.is_alive()]
+            if still:
+                import sys
+
+                print(f"[fleet_executor rank {self.rank}] shutdown "
+                      f"with interceptors still running: {still} — "
+                      "messages may be lost", file=sys.stderr)
+        self.bus.close()
 
 
 class FleetExecutor:

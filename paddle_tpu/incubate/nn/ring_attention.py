@@ -159,12 +159,12 @@ def ulysses_attention(q, k, v, causal=True, sm_scale=None, mesh=None,
     ring wins on memory for extreme sequence lengths. Requires
     num_heads % sp == 0.
 
-    Known host-emulation limitation: XLA:CPU's concurrent thunk
-    executor can deadlock when this cross-module all_to_all overlaps
-    other collectives at certain shapes (rendezvous ordering races in
-    the in-process communicator). The TPU runtime schedules
-    collectives consistently and is unaffected; on CPU test meshes
-    prefer ring attention for large head counts."""
+    Host-emulation note: earlier XLA:CPU builds could deadlock when
+    this cross-module all_to_all overlapped other collectives at large
+    head counts (concurrent-thunk rendezvous ordering races). The
+    current runtime is clean — tests/test_ring_attention.py pins the
+    previously-failing shapes (heads up to 64 inside the hybrid dp×sp
+    train step) as active regression tests."""
     mesh = _sp_mesh_or_none(mesh, seq_axis)
     if mesh is None:
         return _dense_causal_attention(q, k, v, causal, sm_scale)

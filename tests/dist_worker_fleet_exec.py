@@ -1,0 +1,58 @@
+"""Worker for the cross-process FleetExecutor test: a 3-stage pipeline
+split over 2 OS processes connected by the TCP MessageBus
+(reference: fleet_executor/carrier.h:49 — cross-rank dataflow is the
+point; test_fleet_executor_* run multi-rank over brpc).
+
+rank 0: source `scale` (x * 2) -> `add` (x + 1)   [local edge]
+rank 1: `square` (x ** 2)                          [remote edge 0->1]
+No jax needed — this is the host-side actor runtime.
+"""
+import json
+import os
+import sys
+
+from paddle_tpu.distributed.fleet_executor import (DistFleetExecutor,
+                                                   TaskNode)
+
+
+def build_nodes(fail_at=None):
+    def add_fn(x):
+        if fail_at is not None and x == fail_at:
+            raise ValueError(f"boom at {x}")
+        return x + 1
+
+    scale = TaskNode(lambda x: x * 2, name="scale")
+    add = TaskNode(add_fn, name="add")
+    square = TaskNode(lambda x: x * x, name="square")
+    scale.add_downstream_task(add)
+    add.add_downstream_task(square)
+    return [scale, add, square]
+
+
+def main(out_prefix):
+    rank = int(os.environ["FLEET_RANK"])
+    endpoints = os.environ["FLEET_ENDPOINTS"].split(",")
+    fail_at = (int(os.environ["FLEET_FAIL_AT"])
+               if os.environ.get("FLEET_FAIL_AT") else None)
+    nodes = build_nodes(fail_at)
+    placement = {"scale": 0, "add": 0, "square": 1}
+    ex = DistFleetExecutor(nodes, placement, rank, endpoints)
+    if rank == 0:
+        ex.run_source("scale", list(range(8)))
+        out = {"role": "source"}
+    else:
+        try:
+            vals = ex.collect_sink("square")
+            out = {"role": "sink", "values": vals}
+        except RuntimeError as e:
+            # remote task failures must surface HERE, not truncate the
+            # stream silently (r3 review finding)
+            out = {"role": "sink", "error": str(e)}
+    ex.shutdown()
+    with open(f"{out_prefix}.fe{rank}", "w") as f:
+        json.dump(out, f)
+    print(f"rank {rank}: {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

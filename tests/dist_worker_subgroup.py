@@ -1,0 +1,76 @@
+"""Worker for eager SUBGROUP collectives over the TCP store
+(reference: test_collective_api_base.py rank-subset new_group tests).
+
+3 ranks: group {0, 2} runs all_reduce / broadcast / all_gather with
+ONLY its members calling (rank 1 never participates — the property the
+world-barrier transport could not provide); plus eager p2p 0 -> 1.
+Each rank writes its observations as JSON.
+"""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import os  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.mesh import new_group_for_axes  # noqa: E402
+
+
+def main(out_prefix):
+    # deliberately NO init_parallel_env: the store-backed subgroup
+    # collectives and p2p are independent of jax's coordination
+    # service (dispatch reads the PADDLE env contract) — this test
+    # covers the store transport deterministically; jax.distributed
+    # integration is covered by the 2-process DP test
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out = {}
+
+    g = new_group_for_axes((), ranks=[0, 2])
+    if rank in (0, 2):
+        # all_reduce: members contribute rank+1 -> 0+1 + 2+1 = 4
+        t = paddle.to_tensor(np.asarray([rank + 1.0], np.float32))
+        dist.all_reduce(t, group=g)
+        out["allreduce"] = float(t.numpy()[0])
+        # PROD over the subgroup: 1 * 3 = 3
+        t2 = paddle.to_tensor(np.asarray([rank + 1.0], np.float32))
+        dist.all_reduce(t2, op=dist.ReduceOp.PROD, group=g)
+        out["prod"] = float(t2.numpy()[0])
+        # broadcast src=2 (group-rank semantics: src is the GLOBAL rank)
+        b = paddle.to_tensor(np.asarray([float(rank)], np.float32))
+        b = dist.broadcast(b, src=2, group=g)
+        out["broadcast"] = float(b.numpy()[0])
+        # all_gather in group order [0, 2]
+        parts = []
+        dist.all_gather(parts, paddle.to_tensor(
+            np.asarray([rank * 10.0], np.float32)), group=g)
+        out["gather"] = [float(p.numpy()[0]) for p in parts]
+    else:
+        # rank 1 does unrelated eager work while the subgroup runs —
+        # proves no global barrier is required
+        out["bystander"] = True
+
+    # eager p2p over the store: 0 sends two sequenced messages to 1
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.asarray([7.0], np.float32)), dst=1)
+        dist.send(paddle.to_tensor(np.asarray([8.0], np.float32)), dst=1)
+    elif rank == 1:
+        r1 = dist.recv(paddle.to_tensor(np.zeros(1, np.float32)), src=0)
+        r2 = dist.recv(paddle.to_tensor(np.zeros(1, np.float32)), src=0)
+        out["recv"] = [float(r1.numpy()[0]), float(r2.numpy()[0])]
+
+    # world barrier before exit: rank 0 hosts the store — leaving
+    # early would tear the transport down under peers mid-collective
+    dist.barrier()
+    with open(f"{out_prefix}.sub{rank}", "w") as f:
+        json.dump(out, f)
+    print(f"rank {rank} -> {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
