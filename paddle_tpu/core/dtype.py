@@ -86,3 +86,22 @@ def default_float_dtype():
 
 def promote(*dtypes):
     return np.result_type(*[jnp.dtype(d) for d in dtypes])
+
+
+def index_dtype():
+    """The dtype integer index/length outputs are ACTUALLY produced in.
+
+    Declared TPU policy (r3 weak #8 — "a framework must not label int32
+    data int64"): when jax x64 is disabled (the default; TPU scalar
+    units are 32-bit and XLA keeps indices in s32), ops whose reference
+    contract says int64 (arange default, argmax/topk indices,
+    sequence-length outputs) produce and DECLARE int32. Enabling
+    ``jax.config.update('jax_enable_x64', True)`` restores true int64.
+    Using this helper instead of a jnp.int64 literal avoids jax's
+    "truncated to int32" UserWarning — the truncation is a documented
+    policy here, not an accident.
+    """
+    import jax
+
+    return jnp.dtype(np.int64 if jax.config.jax_enable_x64
+                     else np.int32)

@@ -34,8 +34,8 @@ from .mesh import Group, get_group, new_group_for_axes, world_group
 __all__ = [
     "ReduceOp", "all_reduce", "broadcast", "reduce", "all_gather",
     "scatter", "alltoall", "all_to_all", "send", "recv", "barrier",
-    "new_group", "wait", "get_group", "is_initialized",
-    "split_axis_in_trace",
+    "new_group", "wait", "get_group", "get_group_rank",
+    "is_initialized", "split_axis_in_trace",
 ]
 
 
@@ -231,16 +231,40 @@ def _flat_rank(axes):
     return r
 
 
+def get_group_rank(group, global_rank):
+    """Map a GLOBAL rank to its group-local index (reference
+    collective.py get_group_rank). Returns -1 for non-members."""
+    if group is None or not group.ranks:
+        return int(global_rank)  # world group: identity
+    ranks = [int(r) for r in group.ranks]
+    return ranks.index(int(global_rank)) if int(global_rank) in ranks \
+        else -1
+
+
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast analog — single-controller: value is already
     replicated; in shard_map trace, select src's value via a masked
     psum: O(1) extra memory per rank, vs a full world-size all_gather
     that materializes prod(axis sizes)x the tensor just to index one
-    shard."""
+    shard.
+
+    `src` convention (ADVICE r3, normalized once here): src is a GLOBAL
+    rank, mapped to the group-local index via get_group_rank — the
+    reference's convention — in every regime. For mesh-structural axes
+    groups with no explicit rank list (one group instance per mesh
+    position), a global rank is ambiguous across instances, so src is
+    the group-local flat index there (as the topology helpers already
+    compute it)."""
     axes = _axis_names(group)
+    local_src = (get_group_rank(group, src)
+                 if group is not None and group.ranks else int(src))
+    if local_src < 0:
+        raise ValueError(
+            f"broadcast src={src} is not a member of group "
+            f"{group.ranks if group is not None else 'world'}")
     if _in_collective_trace(axes):
         def _k(v):
-            contrib = jnp.where(_flat_rank(axes) == src, v,
+            contrib = jnp.where(_flat_rank(axes) == local_src, v,
                                 jnp.zeros_like(v))
             if v.dtype == jnp.bool_:
                 return lax.psum(contrib.astype(jnp.int32), axes) != 0

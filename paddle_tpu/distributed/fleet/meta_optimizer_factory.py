@@ -59,18 +59,9 @@ def apply_strategy(model, optimizer, strategy):
 
     compiler_kwargs = {}
 
-    # approximate-gradient comm optimizers are a DESIGN refusal, not a
-    # silent no-op (round-1 rule: dead API raises). DGC/LocalSGD exist
-    # to cut NCCL bandwidth at a convergence cost; ICI allreduce inside
-    # the compiled step is cheap and exact, so they are not implemented.
-    for knob in ("dgc", "localsgd", "adaptive_localsgd"):
-        if getattr(strategy, knob, False):
-            raise NotImplementedError(
-                f"DistributedStrategy.{knob}: approximate-gradient "
-                "communication optimizers are intentionally unsupported "
-                "on TPU — in-step allreduce over ICI is exact and "
-                "bandwidth-cheap, so gradient compression/periodic sync "
-                f"would only hurt convergence. Set strategy.{knob}=False.")
+    # dgc/localsgd/adaptive_localsgd refusal now lives in the strategy
+    # schema itself (distributed_strategy._UNSUPPORTED raises at
+    # assignment), so a strategy can never reach here with them truthy
 
     # 1. AMP (reference amp_optimizer — outermost wrapper)
     if strategy.amp:

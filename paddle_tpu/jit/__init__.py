@@ -134,7 +134,10 @@ class StaticFunction:
         # conversion when the source can't be transformed
         from .dy2static import ast_transform
 
-        self._trace_target = ast_transform(func) or func
+        # for_call=True: a function with no control flow of its own
+        # still transforms so conversion reaches its CALLEES (reference
+        # convert_call_func.py recursion — r4)
+        self._trace_target = ast_transform(func, for_call=True) or func
         self._input_spec = input_spec
         self._compiled = {}
         functools.update_wrapper(self, func,

@@ -3,24 +3,39 @@ flow.
 
 Parity target: python/paddle/fluid/dygraph/dygraph_to_static/ — the
 reference rewrites ~20 syntax forms (ifelse_transformer.py,
-loop_transformer.py, ...) into `convert_ifelse` / `convert_while`
-runtime calls that dispatch on whether the condition is a Tensor
-(program_translator.py:775 ProgramTranslator).
+loop_transformer.py, break_continue_transformer.py,
+logical_transformer.py, list_transformer.py, tensor_shape_transformer,
+convert_call_func.py ...) into `convert_*` runtime calls that dispatch
+on whether the value is a Tensor (program_translator.py:775).
 
 TPU-native design: the same two-phase shape. An ast.NodeTransformer
-rewrites `if`/`while` statements into calls of the runtime converters
-below; at trace time a traced (tracer-backed) condition lowers to
-`lax.cond` / `lax.while_loop` (XLA control flow — SURVEY §7 step 4),
-while a concrete condition takes the plain Python branch, so the SAME
+rewrites the syntax forms into calls of the runtime converters below;
+at trace time a traced (tracer-backed) value lowers to `lax.cond` /
+`lax.while_loop` / jnp logical ops (XLA control flow — SURVEY §7 step
+4), while a concrete value takes the plain Python path, so the SAME
 transformed function serves eager and compiled execution.
 
-Scope (documented restrictions, enforced with clear errors + automatic
-fallback to trace-only conversion): no `return`/`break`/`continue`
-inside converted bodies, and the source must be available to
-`inspect.getsource`. Closures are supported by factory re-binding
-(cells are captured by value at conversion time — the reference's
-limitation too); names first bound inside a branch surface as an
-UNDEF sentinel when the other branch is taken (UndefinedVar analog).
+Implemented transforms (r4 closes the r3 gaps):
+  * if/while/for-range      -> convert_ifelse / convert_while
+  * break/continue in loops -> flag variables + trailing-stmt guards
+    (break_continue_transformer.py:87 technique)
+  * and/or/not              -> convert_logical_{and,or,not} with
+    Python value-&-short-circuit semantics on concrete operands
+    (logical_transformer.py)
+  * x.shape                 -> convert_shape (tensor_shape_transformer;
+    static under XLA so this is the identity hook, kept so
+    shape-driven control flow has one interception point)
+  * lst.append(v) statement -> lst = convert_list_append(lst, v)
+    (list_transformer.py:28; traced loops use TensorArray below)
+  * f(...)                  -> convert_call(f)(...) — recursive,
+    runtime-lazy conversion of user callees with a cache
+    (convert_call_func.py)
+  * print/len               -> convert_print / convert_len
+
+Remaining documented restriction: no `return` inside converted
+control flow (fallback to trace-only conversion). Closures are
+supported by factory re-binding (cells captured by value at conversion
+time — the reference's limitation too).
 """
 from __future__ import annotations
 
@@ -28,14 +43,19 @@ import ast
 import functools
 import inspect
 import textwrap
+import warnings
+import weakref
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 __all__ = ["convert_ifelse", "convert_while", "convert_print",
-           "convert_len", "ast_transform", "set_max_loop_iterations",
-           "max_loop_iterations"]
+           "convert_len", "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "convert_shape", "convert_call",
+           "convert_list_append", "check_range_step", "TensorArray",
+           "ast_transform", "set_max_loop_iterations",
+           "max_loop_iterations", "last_loop_truncated"]
 
 # bounded-loop mode: when set, converted `while` lowers to a
 # fixed-trip `lax.scan` with a done-mask instead of `lax.while_loop`.
@@ -46,6 +66,42 @@ __all__ = ["convert_ifelse", "convert_while", "convert_print",
 # condition goes false, making the scan result exactly equal to the
 # dynamic loop whenever the true trip count <= the bound).
 _max_loop_iters = [None]
+
+# truncation diagnostic (ADVICE r3): set by a jax.debug.callback when a
+# bounded-scan loop exits with its condition STILL TRUE — i.e. the true
+# trip count exceeded the bound and the frozen carry is NOT the
+# converged value. Runtime-visible signal, not just a docstring caveat.
+_loop_truncated = [False]
+
+
+def last_loop_truncated():
+    """True if the most recent bounded-scan loop execution was cut off
+    by max_loop_iterations (call jax.effects_barrier() first when the
+    step ran under jit — the signal arrives via debug callback)."""
+    return _loop_truncated[0]
+
+
+def _note_array_overflow(overflowed):
+    if bool(overflowed):
+        warnings.warn(
+            "dy2static: TensorArray.append past capacity inside traced "
+            "code — the write clamped to the last slot and the length "
+            "no longer matches the stored elements. Size the array for "
+            "the loop's maximum trip count.",
+            RuntimeWarning, stacklevel=2)
+
+
+def _note_truncation(cond_still_true):
+    if bool(cond_still_true):
+        _loop_truncated[0] = True
+        warnings.warn(
+            "dy2static: bounded-scan while loop hit "
+            "max_loop_iterations with its condition still true — the "
+            "result is the carry frozen at the bound, NOT the "
+            "converged loop value. Raise set_max_loop_iterations().",
+            RuntimeWarning, stacklevel=2)
+    else:
+        _loop_truncated[0] = False
 
 
 def set_max_loop_iterations(n):
@@ -71,8 +127,6 @@ def max_loop_iterations():
     try:
         v = int(env)
     except ValueError:
-        import warnings
-
         warnings.warn(
             "FLAGS_dy2static_max_loop_iterations={!r} is not an integer "
             "— ignoring (bounded-loop lowering disabled)".format(env))
@@ -105,9 +159,37 @@ def _truthy(p):
     return bool(p)
 
 
+def _is_tensor_leaf(x):
+    from ..core.tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _to_jax_tree(v):
+    """Loop-var -> jax pytree: Tensor leaves unwrap, everything else
+    (ints, arrays, TensorArray children) jnp.asarray's. Lists/tuples/
+    TensorArrays carry through as pytrees with STATIC structure."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(_unwrap(x)), v, is_leaf=_is_tensor_leaf)
+
+
+def _from_jax_tree(v):
+    return jax.tree_util.tree_map(_wrap, v)
+
+
+def _check_no_undef(v, ctx):
+    for leaf in jax.tree_util.tree_leaves(
+            v, is_leaf=lambda x: isinstance(x, _Undefined)):
+        if isinstance(leaf, _Undefined):
+            raise ValueError(
+                f"dy2static: a loop/branch variable is read in a traced "
+                f"{ctx} before being assigned a value (the reference's "
+                "UndefinedVar error) — initialize it before the "
+                "construct.")
+
+
 # ---------------------------------------------------------------------------
-# runtime converters (reference convert_operators.py convert_ifelse /
-# convert_while_loop)
+# runtime converters (reference convert_operators.py / convert_call_func.py)
 # ---------------------------------------------------------------------------
 
 def convert_ifelse(pred, true_fn, false_fn, names=()):
@@ -129,7 +211,7 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
                             "but used afterwards — assign it in both "
                             "branches (XLA cond outputs must exist on "
                             "both paths)")
-                    out.append(jnp.asarray(_unwrap(v)))
+                    out.append(_to_jax_tree(v))
                 return tuple(out)
 
             return g
@@ -137,25 +219,24 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
         pv = jnp.reshape(jnp.asarray(p), ()).astype(bool)
         outs = jax.lax.cond(pv, wrap_branch(true_fn),
                             wrap_branch(false_fn), None)
-        return tuple(_wrap(o) for o in outs)
+        return tuple(_from_jax_tree(o) for o in outs)
     taken = true_fn if _truthy(p) else false_fn
     return tuple(taken())
 
 
 def convert_while(cond_fn, body_fn, init_vals):
-    """Tensor condition or traced loop state -> lax.while_loop;
+    """Tensor condition -> lax.while_loop (or bounded lax.scan when
+    max_loop_iterations is set — the differentiable lowering);
     otherwise a plain Python loop. cond_fn/body_fn take the loop vars
-    positionally; body_fn returns their updated tuple.
+    positionally; body_fn returns their updated tuple. Loop vars may be
+    pytrees (lists of tensors, TensorArray) with static structure.
 
     Differentiation note: XLA's `while` has no general reverse-mode
-    rule (dynamic trip count), so converted `while` loops support
-    forward/inference and paths whose loop carry needs no gradient
-    (counters, stopping criteria under stop_gradient). Gradients
-    through a dynamic loop carry raise jax's clear error; use
-    fixed-trip-count Python `for` loops (unrolled at trace time) or
-    `lax.scan`-style ops for differentiable iteration — the same
-    boundary the reference's static While places on its users in
-    practice."""
+    rule (dynamic trip count); the bounded-scan mode is the
+    differentiable path (scan has a VJP). A bounded loop that hits the
+    bound with its condition still true warns at run time and sets
+    last_loop_truncated() (ADVICE r3 — silent truncation was the old
+    behavior)."""
     init_vals = tuple(init_vals)
     p0 = cond_fn(*init_vals)
     # traced path iff the CONDITION is traced (reference
@@ -163,52 +244,80 @@ def convert_while(cond_fn, body_fn, init_vals):
     # tensor). A concrete condition with traced loop vars stays a
     # Python loop — unrolled at trace time, keeping ints/floats of the
     # induction variable genuinely concrete (float(i), range nesting).
+    # If the condition BECOMES traced mid-unroll (a `while i < n` whose
+    # break flag is set by a tensor predicate), the Python iterations
+    # are discarded and the loop RESTARTS as a traced lowering from a
+    # SNAPSHOT of the init values (mutable containers shallow-copied
+    # up front, so in-place appends from the discarded iterations don't
+    # leak into the restart). Tensor math in the discarded iterations
+    # is pure under tracing; debug prints may fire twice (documented).
     if _is_traced(p0):
-        def cond_c(vals):
-            r = cond_fn(*[_wrap(v) for v in vals])
-            return jnp.reshape(jnp.asarray(_unwrap(r)), ()).astype(bool)
-
-        def body_c(vals):
-            outs = body_fn(*[_wrap(v) for v in vals])
-            return tuple(jnp.asarray(_unwrap(o)) for o in outs)
-
-        init = tuple(jnp.asarray(_unwrap(v)) for v in init_vals)
-        bound = max_loop_iterations()
-        if bound is not None:
-            # bounded scan + done-mask: runs exactly `bound` steps but
-            # freezes the carry once the condition goes false — equal
-            # to the dynamic loop when trip count <= bound, and
-            # reverse-differentiable (scan has a VJP; while does not)
-            def scan_step(carry, _):
-                vals, done = carry
-                new_vals = body_c(vals)
-                keep = jnp.logical_or(done,
-                                      jnp.logical_not(cond_c(vals)))
-                out = tuple(jnp.where(keep, v, nv)
-                            for v, nv in zip(vals, new_vals))
-                return (out, keep), None
-
-            (outs, _), _ = jax.lax.scan(
-                scan_step, (init, jnp.asarray(False)), None,
-                length=bound)
-        else:
-            outs = jax.lax.while_loop(cond_c, body_c, init)
-        return tuple(_wrap(o) for o in outs)
+        return _traced_while(cond_fn, body_fn, init_vals)
+    snapshot = _snapshot_containers(init_vals)
     vals = init_vals
     p = p0  # reuse the probe — the condition must not run twice
     while True:
         if _is_traced(p):
-            raise ValueError(
-                "dy2static: the while condition became a traced tensor "
-                "after the first iteration (it started concrete) — the "
-                "loop cannot switch lowering mid-flight. Make the "
-                "condition depend on tensors from iteration 0, or keep "
-                "it fully concrete.")
+            return _traced_while(cond_fn, body_fn, snapshot)
         if not _truthy(_unwrap(p)):
             break
         vals = tuple(body_fn(*vals))
         p = cond_fn(*vals)
     return vals
+
+
+def _snapshot_containers(v):
+    """Shallow-copy mutable containers (recursively) so a traced-loop
+    restart starts from the pre-unroll state; leaves (tensors, arrays,
+    scalars, TensorArray — functional by design) pass through."""
+    if isinstance(v, list):
+        return [_snapshot_containers(x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_snapshot_containers(x) for x in v)
+    if isinstance(v, dict):
+        return {k: _snapshot_containers(x) for k, x in v.items()}
+    if isinstance(v, set):
+        return set(v)
+    return v
+
+
+def _traced_while(cond_fn, body_fn, init_vals):
+    _check_no_undef(init_vals, "while loop")
+
+    def cond_c(vals):
+        r = cond_fn(*[_from_jax_tree(v) for v in vals])
+        return jnp.reshape(jnp.asarray(_unwrap(r)), ()).astype(bool)
+
+    def body_c(vals):
+        outs = body_fn(*[_from_jax_tree(v) for v in vals])
+        return tuple(_to_jax_tree(o) for o in outs)
+
+    init = tuple(_to_jax_tree(v) for v in init_vals)
+    bound = max_loop_iterations()
+    if bound is not None:
+        # bounded scan + done-mask: runs exactly `bound` steps but
+        # freezes the carry once the condition goes false — equal
+        # to the dynamic loop when trip count <= bound, and
+        # reverse-differentiable (scan has a VJP; while does not)
+        def scan_step(carry, _):
+            vals, done = carry
+            new_vals = body_c(vals)
+            keep = jnp.logical_or(done,
+                                  jnp.logical_not(cond_c(vals)))
+            out = jax.tree_util.tree_map(
+                lambda v, nv: jnp.where(keep, v, nv),
+                vals, new_vals)
+            return (out, keep), None
+
+        (outs, _), _ = jax.lax.scan(
+            scan_step, (init, jnp.asarray(False)), None,
+            length=bound)
+        # surface truncation: condition still true at exit means
+        # the frozen carry is NOT the loop's converged value
+        jax.debug.callback(_note_truncation, cond_c(outs))
+    else:
+        outs = jax.lax.while_loop(cond_c, body_c, init)
+    return tuple(_from_jax_tree(o) for o in outs)
 
 
 def convert_print(*args, **kwargs):
@@ -228,11 +337,260 @@ def convert_len(x):
     int during tracing — delegate, preserving eager semantics exactly
     (incl. the TypeError on 0-D tensors). The converter exists as the
     hook point the reference architecture prescribes."""
+    if isinstance(x, TensorArray):
+        return x.length
     return len(x)
 
 
+def convert_logical_and(x, y_fn):
+    """`x and y` (logical_transformer.py convert_logical_and). Concrete
+    x keeps Python's exact value-and-short-circuit semantics (`[] and
+    f()` returns [] without calling f); a traced x evaluates both sides
+    and lowers to jnp.logical_and."""
+    if _is_traced(x):
+        y = y_fn()
+        return _wrap(jnp.logical_and(
+            jnp.asarray(_unwrap(x)).astype(bool),
+            jnp.asarray(_unwrap(y)).astype(bool)))
+    if not _truthy(_unwrap(x)):
+        return x
+    return y_fn()
+
+
+def convert_logical_or(x, y_fn):
+    if _is_traced(x):
+        y = y_fn()
+        return _wrap(jnp.logical_or(
+            jnp.asarray(_unwrap(x)).astype(bool),
+            jnp.asarray(_unwrap(y)).astype(bool)))
+    if _truthy(_unwrap(x)):
+        return x
+    return y_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        return _wrap(jnp.logical_not(
+            jnp.asarray(_unwrap(x)).astype(bool)))
+    return not _truthy(_unwrap(x))
+
+
+def convert_shape(x):
+    """tensor_shape_transformer hook. Under XLA every shape is static,
+    so for tensors this returns the concrete tuple the attribute
+    already yields — the converter exists so shape-driven control flow
+    has one interception point (and non-tensor objects delegate to
+    their own .shape exactly)."""
+    return x.shape
+
+
+def check_range_step(step):
+    """Python `range(a, b, 0)` raises ValueError; the while-lowering
+    would silently produce a zero-trip loop (ADVICE r3). Traced steps
+    cannot be checked at trace time (documented)."""
+    if _is_traced(step):
+        return step
+    try:
+        v = int(np.asarray(_unwrap(step)))
+    except Exception:
+        return step
+    if v == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return step
+
+
+# -- list / container mutation (list_transformer.py:28) ---------------------
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Fixed-capacity tensor array — the LoDTensorArray analog.
+
+    The reference converts `a = []; a.append(t)` inside static loops
+    into array_write on a growable LoDTensorArray; its interpreter
+    runtime tolerates dynamic sizes. XLA does not: compiled control
+    flow needs a static carry structure. The TPU-native form is a
+    preallocated [capacity, *shape] buffer plus a length scalar,
+    registered as a pytree so it threads through lax.scan/while/cond
+    as a converted loop variable. `append` is functional (returns the
+    updated array) because the loop transformer rebinds the name:
+    `a.append(x)` statements become `a = convert_list_append(a, x)`.
+    """
+
+    def __init__(self, capacity, shape=(), dtype="float32",
+                 _buffer=None, _length=None):
+        if _buffer is not None:
+            self.buffer = _buffer
+            self._length = _length
+        else:
+            from ..core.dtype import convert_dtype
+
+            self.buffer = jnp.zeros(
+                (int(capacity),) + tuple(int(s) for s in shape),
+                convert_dtype(dtype) or jnp.float32)
+            self._length = jnp.asarray(0, jnp.int32)
+
+    # pytree protocol — static structure, dynamic leaves
+    def tree_flatten(self):
+        return (self.buffer, self._length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        buf, ln = children
+        return cls(0, _buffer=buf, _length=ln)
+
+    @property
+    def capacity(self):
+        b = _unwrap(self.buffer)
+        return int(b.shape[0])
+
+    @property
+    def length(self):
+        """Concrete int when possible (eager), else traced scalar."""
+        ln = _unwrap(self._length)
+        if isinstance(ln, jax.core.Tracer):
+            return ln
+        ln = int(ln)
+        if ln > self.capacity:
+            _note_array_overflow(True)
+        return ln
+
+    def append(self, v):
+        buf = jnp.asarray(_unwrap(self.buffer))
+        ln = _unwrap(self._length)
+        cap = buf.shape[0]
+        if not _is_traced(ln) and int(ln) >= cap:
+            raise IndexError(
+                f"TensorArray.append past capacity {cap} — "
+                "dynamic_update would silently clamp to the last "
+                "slot; size the array for the loop's maximum trip "
+                "count")
+        # traced appends can't be checked in-flight (a bounded-scan's
+        # frozen lanes still execute this op on dead values) — the
+        # overflow surfaces when .length/.stack() sees the final
+        # concrete length exceed capacity
+        ln = jnp.asarray(ln)
+        val = jnp.asarray(_unwrap(v), buf.dtype)
+        new = jax.lax.dynamic_update_index_in_dim(
+            buf, val, ln.astype(jnp.int32), axis=0)
+        return TensorArray(0, _buffer=new, _length=ln + 1)
+
+    def __getitem__(self, i):
+        buf = jnp.asarray(_unwrap(self.buffer))
+        if _is_traced(i) or _is_traced(buf):
+            out = jax.lax.dynamic_index_in_dim(
+                buf, jnp.asarray(_unwrap(i), jnp.int32), axis=0,
+                keepdims=False)
+        else:
+            out = buf[int(np.asarray(_unwrap(i)))]
+        return _wrap(out)
+
+    def __len__(self):
+        ln = self.length
+        if isinstance(ln, jax.core.Tracer):
+            raise TypeError(
+                "len() of a TensorArray with traced length — use "
+                ".length for the traced scalar")
+        return ln
+
+    def stack(self):
+        """[capacity, *shape] buffer as a Tensor (slots >= length hold
+        zeros). A dynamic-length slice would be a dynamic shape — use
+        .length to mask downstream."""
+        ln = _unwrap(self._length)
+        if not isinstance(ln, jax.core.Tracer) and int(ln) > self.capacity:
+            _note_array_overflow(True)
+        return _wrap(jnp.asarray(_unwrap(self.buffer)))
+
+    def __repr__(self):
+        return (f"TensorArray(capacity={self.capacity}, "
+                f"length={self.length})")
+
+
+def convert_list_append(lst, val):
+    """`lst.append(val)` statement rewrite target. Plain lists mutate
+    in place (Python loops / unrolled tracing — identical semantics);
+    TensorArray appends functionally so the rebinding threads it
+    through a traced loop carry."""
+    if isinstance(lst, TensorArray):
+        return lst.append(val)
+    lst.append(val)
+    return lst
+
+
+# -- recursive call conversion (convert_call_func.py) -----------------------
+
+_SKIP_CALL_MODULES = frozenset({
+    "paddle_tpu", "jax", "jaxlib", "numpy", "np", "flax", "optax",
+    "builtins", "functools", "itertools", "math", "operator", "typing",
+    "collections", "torch"})
+# weak keys: per-call function objects (lambdas, nested defs) must not
+# accumulate — a strong cache would pin every closure's captured
+# environment forever. Keying by the function OBJECT (not __code__) is
+# required for correctness: ast_transform re-binds closure cells by
+# VALUE, so two closures sharing a code object need distinct entries.
+_convert_call_cache: "weakref.WeakKeyDictionary" = \
+    weakref.WeakKeyDictionary()
+
+
+def convert_call(fn):
+    """Runtime-lazy recursive conversion of callees (reference
+    convert_call_func.py convert_call): user functions and methods get
+    ast_transform'd (so THEIR control flow converts too, and their call
+    sites recurse further); framework/library/builtin callables pass
+    through untouched. Every transformed call site is wrapped
+    `convert_call(f)(...)` — conversion happens at call time with a
+    cache, which is what makes recursion terminate and keeps cold
+    imports cheap."""
+    if fn is None or isinstance(fn, _Undefined):
+        return fn
+    try:
+        if isinstance(fn, functools.partial):
+            inner = convert_call(fn.func)
+            if inner is not fn.func:
+                return functools.partial(inner, *fn.args,
+                                         **(fn.keywords or {}))
+            return fn
+        if inspect.isclass(fn) or inspect.isbuiltin(fn):
+            return fn
+        if inspect.ismethod(fn):
+            conv = convert_call(fn.__func__)
+            return (conv.__get__(fn.__self__)
+                    if conv is not fn.__func__ else fn)
+        if not inspect.isfunction(fn):
+            # callable object — convert a Layer's forward when no hooks
+            # intercept __call__ (the reference converts
+            # Layer.forward via StaticFunction)
+            fwd = getattr(fn, "forward", None)
+            if (fwd is not None and callable(fn)
+                    and not getattr(fn, "_forward_pre_hooks", True)
+                    and not getattr(fn, "_forward_post_hooks", True)):
+                conv = convert_call(fwd)
+                if conv is not fwd:
+                    return conv
+            return fn
+        mod = (getattr(fn, "__module__", "") or "").split(".")[0]
+        if mod in _SKIP_CALL_MODULES:
+            return fn
+        if getattr(fn, "__jst_converted__", False):
+            return fn
+        if fn in _convert_call_cache:
+            return _convert_call_cache[fn] or fn
+        _convert_call_cache[fn] = None
+        new = ast_transform(fn, for_call=True)
+        if new is not None:
+            try:
+                new.__jst_converted__ = True
+            except AttributeError:
+                pass
+        _convert_call_cache[fn] = new
+        return new or fn
+    except Exception:
+        return fn
+
+
 # ---------------------------------------------------------------------------
-# AST transformer (reference ifelse_transformer.py / loop_transformer.py)
+# AST transformer (reference ifelse_transformer.py / loop_transformer.py /
+# break_continue_transformer.py / logical_transformer.py)
 # ---------------------------------------------------------------------------
 
 class _Unsupported(Exception):
@@ -245,6 +603,12 @@ class _Undefined:
 
     def __repr__(self):
         return "<undefined branch variable>"
+
+    def __bool__(self):
+        raise ValueError(
+            "dy2static: read of a variable before assignment "
+            "(a for-loop induction variable after a zero-trip loop, or "
+            "a name bound in an untaken branch)")
 
 
 UNDEF = _Undefined()
@@ -306,7 +670,31 @@ def _assigned_names(nodes):
     return names
 
 
-def _check_no_flow_escape(nodes):
+def _walk_shallow(node):
+    """Walk `node`, NOT descending into nested loops or function
+    defs — break/continue found here belong to the CURRENT loop."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.For, ast.While, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has_own_break_continue(stmts):
+    has_b = has_c = False
+    for s in stmts:
+        for n in _walk_shallow(s):
+            if isinstance(n, ast.Break):
+                has_b = True
+            elif isinstance(n, ast.Continue):
+                has_c = True
+    return has_b, has_c
+
+
+def _check_no_return(nodes):
     class V(ast.NodeVisitor):
         def visit_FunctionDef(self, node):
             pass
@@ -316,14 +704,63 @@ def _check_no_flow_escape(nodes):
         def visit_Return(self, node):
             raise _Unsupported("return inside converted control flow")
 
-        def visit_Break(self, node):
-            raise _Unsupported("break inside converted control flow")
-
-        def visit_Continue(self, node):
-            raise _Unsupported("continue inside converted control flow")
-
     for n in nodes:
         V().visit(n)
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+
+def _assign(n, value):
+    return ast.Assign(targets=[_name(n, ast.Store)], value=value)
+
+
+def _not_flags_test(flags):
+    """`not (f1 or f2)` — emitted as plain BoolOp so the logical
+    transformer converts it for traced flags."""
+    expr = _name(flags[0])
+    for f in flags[1:]:
+        expr = ast.BoolOp(op=ast.Or(), values=[expr, _name(f)])
+    return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+
+def _rewrite_break_continue(stmts, brk, cont, flags):
+    """break_continue_transformer.py:87 technique: replace this loop's
+    Break/Continue with flag assignments; statements AFTER a
+    flag-setting statement wrap in `if not (flags):` so control skips
+    them exactly as break/continue would. Statements directly after a
+    bare break/continue are unreachable and drop."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_assign(brk, ast.Constant(value=True)))
+            return out  # rest unreachable
+        if isinstance(s, ast.Continue):
+            out.append(_assign(cont, ast.Constant(value=True)))
+            return out
+        may_set = any(isinstance(n, (ast.Break, ast.Continue))
+                      for n in _walk_shallow(s))
+        if may_set:
+            if isinstance(s, ast.If):
+                s = ast.If(
+                    test=s.test,
+                    body=_rewrite_break_continue(s.body, brk, cont,
+                                                 flags) or [ast.Pass()],
+                    orelse=_rewrite_break_continue(s.orelse, brk, cont,
+                                                   flags))
+            elif isinstance(s, (ast.Try, ast.With, ast.AsyncWith)):
+                raise _Unsupported(
+                    "break/continue inside try/with in a converted loop")
+            out.append(s)
+            rest = _rewrite_break_continue(stmts[i + 1:], brk, cont,
+                                           flags)
+            if rest:
+                out.append(ast.If(test=_not_flags_test(flags),
+                                  body=rest, orelse=[]))
+            return out
+        out.append(s)
+    return out
 
 
 def _loaded_names(node):
@@ -356,6 +793,18 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self._n = 0
         # root kept for per-If "loads outside this if" liveness
         self._root = fdef
+        # names local to the function (params + assignments): the
+        # append rewrite may only rebind these — rebinding a global or
+        # closure list would shadow it with an UnboundLocalError
+        self._local_names = set()
+        if fdef is not None:
+            args = fdef.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                self._local_names.add(a.arg)
+            for extra in (args.vararg, args.kwarg):
+                if extra is not None:
+                    self._local_names.add(extra.arg)
+            self._local_names.update(_assigned_names(fdef.body))
 
     def _fresh(self, kind):
         self._n += 1
@@ -364,6 +813,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def _names_tuple(self, names, ctx):
         return ast.Tuple(
             elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def _jst_call(self, attr, args):
+        return ast.Call(
+            func=ast.Attribute(value=_name("_jst"), attr=attr,
+                               ctx=ast.Load()),
+            args=args, keywords=[])
 
     def _undef_guards(self, names):
         """Pre-seed names first bound inside the construct with the
@@ -384,6 +839,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 orelse=[], finalbody=[]))
         return guards
 
+    # -- logical transformer (logical_transformer.py) -------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[-1]
+        # fold right-assoc: a and b and c -> and(a, λ: and(b, λ: c))
+        for v in reversed(node.values[:-1]):
+            lam = ast.Lambda(args=_no_args(), body=expr)
+            expr = self._jst_call(conv, [v, lam])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return self._jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # -- tensor-shape transformer (tensor_shape_transformer.py) ---------
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+        if node.attr == "shape" and isinstance(node.ctx, ast.Load):
+            return self._jst_call("convert_shape", [node.value])
+        return node
+
     def visit_If(self, node):
         # liveness BEFORE transforming children (the rewrite introduces
         # loads of every threaded name)
@@ -392,17 +872,33 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         outside_loads = (_loads_excluding(self._root, node)
                          if self._root is not None else None)
         self.generic_visit(node)
-        _check_no_flow_escape(node.body)
-        _check_no_flow_escape(node.orelse)
+        _check_no_return(node.body)
+        _check_no_return(node.orelse)
+        # break/continue at this level belong to an ENCLOSING loop —
+        # that loop's visit rewrites them before its ifs reach here; if
+        # any survive (if outside a loop == SyntaxError anyway), bail
+        for part in (node.body, node.orelse):
+            if any(isinstance(n, (ast.Break, ast.Continue))
+                   for s in part for n in _walk_shallow(s)):
+                raise _Unsupported(
+                    "break/continue escaped loop rewriting")
         names = _assigned_names(node.body + node.orelse)
         if outside_loads is not None:
             # thread a name through lax.cond only when BOTH branches
             # produce it, or a load OUTSIDE this if reads it —
             # branch-local temporaries stay local (they'd otherwise
-            # surface UNDEF through the other branch)
+            # surface UNDEF through the other branch). Synthesized
+            # break/continue FLAGS always thread: their reads live in
+            # guard tests synthesized after root liveness was captured
+            # (and in deep-copied for-loop bodies root can't see at
+            # all), so the load scan would drop them. Only the flags —
+            # other __jst_ temps (range stop/step/k) are genuinely
+            # branch-local when a for-loop sits inside one branch.
             names = [n for n in names
                      if (n in assigned_t and n in assigned_f)
-                     or n in outside_loads]
+                     or n in outside_loads
+                     or n.startswith("__jst_brk_")
+                     or n.startswith("__jst_cont_")]
         tname, fname = self._fresh("true"), self._fresh("false")
         # each branch takes the assigned names as DEFAULT arguments
         # bound at def time: a branch can read a name it also assigns
@@ -424,13 +920,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             body=(list(node.orelse) or [ast.Pass()]) + [
                 ast.Return(value=self._names_tuple(names, ast.Load))],
             decorator_list=[])
-        call = ast.Call(
-            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
-                               attr="convert_ifelse", ctx=ast.Load()),
-            args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
-                  ast.Name(id=fname, ctx=ast.Load()),
-                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
-                            ctx=ast.Load())], keywords=[])
+        call = self._jst_call("convert_ifelse", [
+            node.test, _name(tname), _name(fname),
+            ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                      ctx=ast.Load())])
         if names:
             assign = ast.Assign(
                 targets=[self._names_tuple(names, ast.Store)], value=call)
@@ -438,11 +931,35 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             assign = ast.Expr(value=call)
         return guards + [tdef, fdef, assign]
 
+    # -- list transformer (list_transformer.py:28) ----------------------
+    def visit_Expr(self, node):
+        """`x.append(v)` STATEMENT -> `x = _jst.convert_list_append(x,
+        v)`: the rebinding is what threads the container through a
+        traced loop carry (a bare method call would leave the name out
+        of the loop's assigned set)."""
+        call = node.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in self._local_names
+                and len(call.args) == 1 and not call.keywords):
+            tgt = call.func.value.id
+            arg = self.visit(call.args[0])
+            return _assign(tgt, self._jst_call(
+                "convert_list_append", [_name(tgt), arg]))
+        self.generic_visit(node)
+        return node
+
+    # -- call transformer (convert_call_func.py) ------------------------
+    _NO_WRAP_CALLS = frozenset({
+        "range", "super", "print", "len", "isinstance", "type",
+        "getattr", "setattr", "hasattr", "enumerate", "zip", "id"})
+
     def visit_Call(self, node):
-        """print/len transforms (reference print_transformer.py /
-        convert_call len handling): bare-name calls of the builtins are
-        routed through the runtime converters so traced tensors get
-        run-time printing / static-shape len."""
+        """print/len route through their converters; every other call
+        site wraps `_jst.convert_call(f)(...)` so user callees convert
+        recursively at call time (reference convert_call_func.py)."""
         self.generic_visit(node)
         if isinstance(node.func, ast.Name) and node.func.id in (
                 "print", "len") and not node.keywords:
@@ -452,14 +969,30 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     value=ast.Name(id="_jst", ctx=ast.Load()),
                     attr=conv[node.func.id], ctx=ast.Load()),
                 args=node.args, keywords=[])
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in self._NO_WRAP_CALLS:
+            return node
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "_jst"):
+            return node  # our own converter calls
+        node.func = self._jst_call("convert_call", [fn])
         return node
 
     def visit_For(self, node):
         """for-range transform (reference loop_transformer.py
-        for_loop_fn): `for i in range(...)` becomes an index-carrying
+        for_loop_fn): `for i in range(...)` becomes a HIDDEN-counter
         while so a TRACED stop/step lowers through convert_while.
-        Non-range iterables keep the Python loop (tensors iterate
-        row-wise with static shapes — already trace-safe)."""
+        ADVICE r3 fixes: range args evaluate in source order
+        (start, stop, step); the induction variable is assigned at the
+        TOP of each iteration from the hidden counter, so its post-loop
+        value matches Python (start + (n-1)*step, or its prior binding
+        on a zero-trip loop; a previously-unbound variable after a
+        zero-trip loop reads as start — the one documented divergence,
+        Python leaves it unbound); step==0 raises ValueError via
+        check_range_step. Non-range iterables keep the Python loop
+        (tensors iterate row-wise with static shapes — already
+        trace-safe)."""
         if node.orelse:
             raise _Unsupported("for/else")
         it = node.iter
@@ -472,63 +1005,132 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             except _Unsupported:
                 pass  # keep the untouched Python loop
             return node
-        a = it.args
-        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
-        stop = a[0] if len(a) == 1 else a[1]
-        step = a[2] if len(a) == 3 else ast.Constant(value=1)
-        iv = node.target.id
-        stop_n, step_n = self._fresh("stop"), self._fresh("step")
-        # range() args evaluate BEFORE the target rebinds (Python
-        # semantics: `i = 4; for i in range(0, i)` runs 4 times) —
-        # stash stop/step in temps first, assign the target last
-        pre = [
-            ast.Assign(targets=[ast.Name(id=stop_n, ctx=ast.Store())],
-                       value=stop),
-            ast.Assign(targets=[ast.Name(id=step_n, ctx=ast.Store())],
-                       value=step),
-            ast.Assign(targets=[ast.Name(id=iv, ctx=ast.Store())],
-                       value=start),
-        ]
-        # i*sign(step) < stop*sign(step) handles negative steps; for
-        # the common positive-step case XLA folds the sign constants
-        test = ast.Compare(
-            left=ast.BinOp(left=ast.Name(id=iv, ctx=ast.Load()),
-                           op=ast.Mult(),
-                           right=ast.Name(id=step_n, ctx=ast.Load())),
-            ops=[ast.Lt()],
-            comparators=[ast.BinOp(
-                left=ast.Name(id=stop_n, ctx=ast.Load()), op=ast.Mult(),
-                right=ast.Name(id=step_n, ctx=ast.Load()))])
-        bump = ast.Assign(
-            targets=[ast.Name(id=iv, ctx=ast.Store())],
-            value=ast.BinOp(left=ast.Name(id=iv, ctx=ast.Load()),
-                            op=ast.Add(),
-                            right=ast.Name(id=step_n, ctx=ast.Load())))
         import copy
 
-        wh = ast.While(test=test,
-                       body=copy.deepcopy(list(node.body)) + [bump],
+        # pristine copy for the fallback path: the while-synthesis
+        # below transforms the ORIGINAL statements in place (identity
+        # in self._root must be preserved for _loads_excluding — a
+        # deep-copied body made every branch-local temp look like an
+        # outside load), so on _Unsupported we return this untouched
+        # copy instead of a half-transformed loop
+        pristine = copy.deepcopy(node)
+        # range args get visited here: they are re-emitted as `pre`
+        # statements the transformer never revisits, and calls inside
+        # them must still route through convert_call
+        a = [self.visit(arg) for arg in it.args]
+        iv = node.target.id
+        start_n, stop_n, step_n = (self._fresh("start"),
+                                   self._fresh("stop"),
+                                   self._fresh("step"))
+        k_n = self._fresh("k")
+        # evaluate range() args LEFT-TO-RIGHT in source order (ADVICE
+        # r3: stop/step/start order was observable with side effects)
+        pre = []
+        if len(a) == 1:
+            pre.append(_assign(stop_n, a[0]))
+            pre.append(_assign(start_n, ast.Constant(value=0)))
+            pre.append(_assign(step_n, ast.Constant(value=1)))
+        else:
+            pre.append(_assign(start_n, a[0]))
+            pre.append(_assign(stop_n, a[1]))
+            pre.append(_assign(step_n,
+                               a[2] if len(a) == 3
+                               else ast.Constant(value=1)))
+            if len(a) == 3:
+                pre.append(ast.Expr(value=self._jst_call(
+                    "check_range_step", [_name(step_n)])))
+        # hidden counter carries iteration; the user-visible target is
+        # assigned from it at the top of each iteration (Python: the
+        # target holds the LAST item after the loop, body rebindings
+        # included, and keeps its prior value on a zero-trip loop)
+        pre.append(_assign(k_n, _name(start_n)))
+        # seed iv from start only when previously unbound (zero-trip +
+        # previously-bound keeps the old value, matching Python)
+        pre.append(ast.Try(
+            body=[ast.Expr(value=_name(iv))],
+            handlers=[ast.ExceptHandler(
+                type=_name("NameError"), name=None,
+                body=[_assign(iv, _name(start_n))])],
+            orelse=[], finalbody=[]))
+        # k*sign(step) < stop*sign(step) handles negative steps; for
+        # the common positive-step case XLA folds the sign constants
+        test = ast.Compare(
+            left=ast.BinOp(left=_name(k_n), op=ast.Mult(),
+                           right=_name(step_n)),
+            ops=[ast.Lt()],
+            comparators=[ast.BinOp(
+                left=_name(stop_n), op=ast.Mult(),
+                right=_name(step_n))])
+        body = list(node.body)  # ORIGINAL nodes: identity in root
+        # rewrite THIS loop's break/continue BEFORE synthesizing the
+        # while: the index bump must stay OUTSIDE the continue guard
+        # (Python's continue still advances the iteration)
+        has_b, has_c = _has_own_break_continue(body)
+        brk_n, cont_n = self._fresh("brk"), self._fresh("cont")
+        flags = ([brk_n] if has_b else []) + ([cont_n] if has_c else [])
+        if flags:
+            body = _rewrite_break_continue(body, brk_n, cont_n, flags)
+        iter_head = [_assign(iv, _name(k_n))]
+        if has_c:
+            iter_head.append(_assign(cont_n, ast.Constant(value=False)))
+            # pre-loop init too: the flag is a loop-carried var, and a
+            # traced lowering needs a concrete (non-UNDEF) init value
+            pre.append(_assign(cont_n, ast.Constant(value=False)))
+        bump = _assign(k_n, ast.BinOp(left=_name(k_n), op=ast.Add(),
+                                      right=_name(step_n)))
+        wh_test = (ast.BoolOp(op=ast.And(), values=[
+            test, ast.UnaryOp(op=ast.Not(), operand=_name(brk_n))])
+            if has_b else test)
+        if has_b:
+            pre.append(_assign(brk_n, ast.Constant(value=False)))
+        wh = ast.While(test=wh_test,
+                       body=iter_head + body + [bump],
                        orelse=[])
         try:
-            out = self.visit_While(wh)
+            out = self.visit_While(wh, _bc_done=True)
         except _Unsupported:
-            # break/continue inside: keep the Python for loop (works
-            # whenever the range bounds are concrete). Contain nested
-            # _Unsupported too — a failing child must not downgrade the
-            # WHOLE function to trace-only (its body then stays
-            # unconverted, which plain Python still executes).
+            # unsupported construct inside: keep the PRISTINE Python
+            # for loop (the shared body statements may be
+            # half-transformed by now). Contain nested _Unsupported
+            # too — a failing child must not downgrade the WHOLE
+            # function to trace-only.
             try:
-                self.generic_visit(node)
+                self.generic_visit(pristine)
             except _Unsupported:
                 pass
-            return node
+            return pristine
         return pre + (out if isinstance(out, list) else [out])
 
-    def visit_While(self, node):
-        self.generic_visit(node)
+    def visit_While(self, node, _bc_done=False):
         if node.orelse:
             raise _Unsupported("while/else")
-        _check_no_flow_escape(node.body)
+        pre = []
+        if not _bc_done:
+            # rewrite this loop's own break/continue FIRST — the if
+            # transformer below would otherwise see Break nodes inside
+            # branch functions and bail out
+            has_b, has_c = _has_own_break_continue(node.body)
+            brk_n, cont_n = self._fresh("brk"), self._fresh("cont")
+            flags = ([brk_n] if has_b else []) + (
+                [cont_n] if has_c else [])
+            if flags:
+                node.body = _rewrite_break_continue(
+                    node.body, brk_n, cont_n, flags)
+                if has_c:
+                    node.body = [_assign(cont_n,
+                                         ast.Constant(value=False))
+                                 ] + node.body
+                    pre.append(_assign(cont_n,
+                                       ast.Constant(value=False)))
+                if has_b:
+                    pre.append(_assign(brk_n,
+                                       ast.Constant(value=False)))
+                    node.test = ast.BoolOp(op=ast.And(), values=[
+                        node.test,
+                        ast.UnaryOp(op=ast.Not(),
+                                    operand=_name(brk_n))])
+        self.generic_visit(node)
+        _check_no_return(node.body)
         names = _assigned_names(node.body)
         if not names:
             return node  # stateless loop: leave as python
@@ -546,15 +1148,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             body=list(node.body) + [
                 ast.Return(value=self._names_tuple(names, ast.Load))],
             decorator_list=[])
-        call = ast.Call(
-            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
-                               attr="convert_while", ctx=ast.Load()),
-            args=[ast.Name(id=cname, ctx=ast.Load()),
-                  ast.Name(id=bname, ctx=ast.Load()),
-                  self._names_tuple(names, ast.Load)], keywords=[])
+        call = self._jst_call("convert_while", [
+            _name(cname), _name(bname),
+            self._names_tuple(names, ast.Load)])
         assign = ast.Assign(
             targets=[self._names_tuple(names, ast.Store)], value=call)
-        return guards + [cdef, bdef, assign]
+        return pre + guards + [cdef, bdef, assign]
 
 
 def _no_args():
@@ -563,12 +1162,14 @@ def _no_args():
                          defaults=[])
 
 
-def ast_transform(func):
-    """Rewrite func's if/while into converter calls; returns the new
-    function, or None when conversion is unavailable (no source,
-    closures, unsupported constructs) — callers fall back to
+def ast_transform(func, for_call=False):
+    """Rewrite func's control flow / calls into converter calls;
+    returns the new function, or None when conversion is unavailable
+    (no source, unsupported constructs) — callers fall back to
     trace-only conversion, matching the reference's graceful
-    degradation."""
+    degradation. With for_call=True (the convert_call recursion path)
+    a function with no control flow but with call sites still
+    transforms, so conversion reaches ITS callees."""
     bound_self = None
     if inspect.ismethod(func):
         bound_self = func.__self__
@@ -597,13 +1198,22 @@ def ast_transform(func):
     has_cf = any(isinstance(n, (ast.If, ast.While, ast.For))
                  for n in ast.walk(fdef))
     if not has_cf:
-        return None  # nothing to do — keep the original
+        if not for_call:
+            return None  # nothing to do — keep the original
+        has_calls = any(isinstance(n, ast.Call) for n in ast.walk(fdef))
+        if not has_calls:
+            return None  # leaf function: recursion bottoms out here
     try:
         new_tree = _ControlFlowTransformer(fdef).visit(tree)
     except _Unsupported:
         return None
     ast.fix_missing_locations(new_tree)
     from . import dy2static as _jst_mod
+
+    src_globals = func.__globals__  # capture the DICT, not func: the
+    # rebuilt function's __globals__ chain must not strongly reference
+    # the original function or the weak convert_call cache never drops
+    # per-call entries
 
     class _LiveGlobals(dict):
         """Reads fall through to the function's LIVE module globals
@@ -612,7 +1222,7 @@ def ast_transform(func):
         user's module bindings."""
 
         def __missing__(self, k):
-            return func.__globals__[k]
+            return src_globals[k]
 
     glb = _LiveGlobals()
     glb["__builtins__"] = func.__globals__.get("__builtins__", __builtins__)
@@ -656,6 +1266,11 @@ def ast_transform(func):
         return None
     try:
         functools.update_wrapper(new_fn, func)
+        # update_wrapper pins the ORIGINAL via __wrapped__ — with the
+        # weak convert_call cache that strong path (cache value ->
+        # __wrapped__ -> cache key) would keep per-call closures alive
+        # forever, defeating the weak keys
+        del new_fn.__wrapped__
     except AttributeError:
         pass
     if bound_self is not None:

@@ -6,7 +6,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.dtype import convert_dtype, default_float_dtype
+from ..core.dtype import convert_dtype, default_float_dtype, index_dtype
 from ..core.engine import apply_op, in_trace_mode
 from ..core.tensor import Tensor, to_tensor
 
@@ -107,7 +107,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         if any(isinstance(v, float) for v in (start, end, step)):
             dt = default_float_dtype()
         else:
-            dt = jnp.int64
+            dt = index_dtype()
     return _mk(lambda: jnp.arange(start, end, step, dtype=dt))
 
 

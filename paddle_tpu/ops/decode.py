@@ -96,7 +96,8 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         dist = jnp.take_along_axis(last, b_len[:, None], axis=1)[:, 0]
         if normalized:
             dist = dist / jnp.maximum(b_len.astype(jnp.float32), 1.0)
-        return dist[:, None], jnp.asarray([B], jnp.int64)
+        from ..core.dtype import index_dtype
+        return dist[:, None], jnp.asarray([B], index_dtype())
 
     return apply_op("edit_distance", _k, input, label, input_length,
                     label_length,

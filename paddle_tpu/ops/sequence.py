@@ -239,8 +239,9 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
     pad_v = (pad_value._value if isinstance(pad_value, Tensor)
              else float(pad_value))
     out = apply_op("sequence_pad", _k, _values(x), pv=pad_v)
-    return out, Tensor(jnp.asarray(lens, jnp.int64), stop_gradient=True,
-                       _internal=True)
+    from ..core.dtype import index_dtype
+    return out, Tensor(jnp.asarray(lens, index_dtype()),
+                       stop_gradient=True, _internal=True)
 
 
 def sequence_unpad(x, length, name=None):

@@ -539,3 +539,356 @@ def test_for_break_does_not_downgrade_other_conversions():
     x = paddle.to_tensor(np.asarray([1.0], np.float32))
     n = paddle.to_tensor(np.float32(2.0))
     np.testing.assert_allclose(np.asarray(f(x, n)._value), [8.0])
+
+
+# -- r4 transforms: break/continue, logical, call, list, shape ---------------
+
+def test_break_continue_in_traced_while():
+    """break_continue_transformer.py:87 parity: break driven by a
+    TENSOR predicate inside a while whose counter starts concrete —
+    the loop restarts as a traced lowering (flags become carried
+    booleans, the rest-of-body guards become lax.cond)."""
+    @to_static
+    def f(x, lim):
+        s = x * 0.0
+        i = 0
+        while i < 10:
+            if paddle.sum(s) > lim:
+                break
+            s = s + x
+            i = i + 1
+        return s, i
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    s, i = f(x, paddle.to_tensor(np.float32(5.0)))
+    np.testing.assert_allclose(np.asarray(s._value), 2.0)
+    assert int(np.asarray(i._value)) == 2
+
+
+def test_continue_in_for_advances_index():
+    """continue must still advance the iteration (the bump lives
+    OUTSIDE the continue guard)."""
+    @to_static
+    def f(x):
+        acc = x * 0.0
+        for i in range(6):
+            if i == 2:
+                continue
+            acc = acc + x * float(i)
+        return acc
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    # 0+1+3+4+5 = 13
+    np.testing.assert_allclose(np.asarray(f(x)._value), 13.0)
+
+
+def test_post_loop_induction_variable_matches_python():
+    """ADVICE r3 (medium): after `for i in range(2, 10, 3)` Python
+    leaves i == 8 (start + (n-1)*step), and a zero-trip loop keeps the
+    prior binding."""
+    def f(n):
+        i = 99
+        for i in range(2, n, 3):
+            pass
+        return i
+
+    g = ast_transform(f)
+    assert g is not None
+    for n in (0, 3, 10):
+        assert g(n) == f(n)
+
+
+def test_range_args_evaluate_in_source_order():
+    """ADVICE r3 (low): range(start, stop, step) args evaluate
+    left-to-right, observable with side effects."""
+    order = []
+
+    def s(tag, v):
+        order.append(tag)
+        return v
+
+    def f():
+        acc = 0
+        for i in range(s("start", 1), s("stop", 7), s("step", 2)):
+            acc += i
+        return acc
+
+    g = ast_transform(f)
+    order.clear()
+    ref = f()
+    ref_order = list(order)
+    order.clear()
+    got = g()
+    assert got == ref and order == ref_order == ["start", "stop", "step"]
+
+
+def test_range_step_zero_raises():
+    def f():
+        for i in range(0, 5, 0):
+            pass
+
+    g = ast_transform(f)
+    with pytest.raises(ValueError, match="arg 3"):
+        g()
+
+
+def test_logical_ops_value_semantics():
+    """logical_transformer parity: concrete operands keep Python's
+    value-returning short-circuit semantics exactly."""
+    calls = []
+
+    def f(a, b):
+        r = a and (calls.append("rhs") or b)
+        s = a or b
+        t = not a
+        return r, s, t
+
+    g = ast_transform(f, for_call=True)
+    assert g is not None
+    calls.clear()
+    assert g([], 5) == ([], 5, True)        # `[] and x` short-circuits
+    assert calls == []                       # rhs never evaluated
+    assert g(3, 5) == (5, 3, False)
+
+
+def test_logical_ops_traced_lower_to_jnp():
+    @to_static
+    def f(x, y):
+        if (paddle.sum(x) > 0) and (paddle.sum(y) > 0):
+            r = x + y
+        else:
+            r = x - y
+        return r
+
+    one = paddle.to_tensor(np.ones(2, np.float32))
+    neg = paddle.to_tensor(-np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(f(one, one)._value), 2.0)
+    np.testing.assert_allclose(np.asarray(f(one, neg)._value), 2.0)
+
+
+def test_convert_call_recurses_into_helpers():
+    """convert_call_func.py parity: a helper with its own tensor
+    control flow converts when called from a converted function."""
+    @to_static
+    def f(x, n):
+        return _r4_helper_double_until(x, n) * 2.0
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    out = f(x, paddle.to_tensor(np.float32(5.0)))
+    # helper doubles ones(2) while sum < 5: sums 2 -> 4 -> 8 (stop),
+    # x == [4, 4]; caller doubles once more -> [8, 8]
+    np.testing.assert_allclose(np.asarray(out._value), 8.0)
+
+
+def _r4_helper_double_until(x, lim):
+    while paddle.sum(x) < lim:
+        x = x * 2.0
+    return x
+
+
+def test_tensor_shape_transform():
+    """tensor_shape_transformer parity: shape-driven loop bounds stay
+    concrete under XLA (static shapes), via the convert_shape hook."""
+    @to_static
+    def f(x):
+        acc = x * 0.0
+        for i in range(x.shape[0]):
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.ones((3, 2), np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value), 3.0)
+
+
+def test_list_append_unrolled_loop():
+    """list_transformer.py:28 parity, unrolled path: plain list
+    append inside a concrete-bound loop keeps Python semantics."""
+    @to_static
+    def f(x):
+        outs = []
+        for i in range(3):
+            outs.append(x * float(i))
+        return outs[0] + outs[1] + outs[2]
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(f(x)._value), 3.0)
+
+
+def test_tensor_array_in_traced_loop_trains():
+    """list_transformer traced path: TensorArray (the LoDTensorArray
+    analog — preallocated buffer + length, a pytree) accumulates
+    through a bounded-scan while and is differentiable."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit.dy2static import (TensorArray,
+                                          set_max_loop_iterations)
+
+    def f(x, n):
+        arr = TensorArray(8, shape=(2,), dtype="float32")
+        i = 0
+        while i < n:
+            arr = arr.append(x * (i + 1.0))
+            i = i + 1
+        return arr
+
+    g = ast_transform(f)
+    assert g is not None
+    prev = set_max_loop_iterations(8)
+    try:
+        def loss(xv):
+            arr = g(paddle.to_tensor(xv), paddle.to_tensor(3))
+            out = arr[0] if isinstance(arr, tuple) else arr
+            return jnp.sum(jnp.asarray(out.stack()._value))
+
+        val, grad = jax.value_and_grad(loss)(jnp.ones(2))
+        # x*1 + x*2 + x*3 summed -> grad 6 per element
+        assert abs(float(val) - 12.0) < 1e-5
+        np.testing.assert_allclose(np.asarray(grad), 6.0)
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_bounded_loop_truncation_signal():
+    """ADVICE r3 (low): a bounded-scan loop that hits the bound with
+    its condition still true must SIGNAL, not silently return the
+    frozen carry."""
+    import jax
+    from paddle_tpu.jit.dy2static import (last_loop_truncated,
+                                          set_max_loop_iterations)
+
+    @to_static
+    def f(x):
+        i = x * 0.0
+        while paddle.sum(i) < 10.0:
+            i = i + 1.0
+        return i
+
+    prev = set_max_loop_iterations(4)
+    try:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            f(paddle.to_tensor(np.zeros(1, np.float32)))
+            jax.effects_barrier()
+        assert last_loop_truncated()
+        set_max_loop_iterations(32)
+        f(paddle.to_tensor(np.zeros(1, np.float32)))
+        jax.effects_barrier()
+        assert not last_loop_truncated()
+    finally:
+        set_max_loop_iterations(prev)
+
+
+def test_loop_heavy_model_trains_end_to_end():
+    """Reference dygraph_to_static model-level test pattern (e.g.
+    test_sentiment / tsm): a model whose forward mixes for-range over
+    layers, break on a tensor norm, and list accumulation — trained
+    for a few steps under @to_static, loss must decrease."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    import paddle_tpu.nn.functional as F
+
+    class LoopNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList([nn.Linear(8, 8)
+                                        for _ in range(3)])
+            self.head = nn.Linear(8, 2)
+
+        def forward(self, x):
+            feats = []
+            for i in range(3):
+                x = F.relu(self.blocks[i](x))
+                feats.append(x)
+            merged = feats[0] + feats[1] + feats[2]
+            return self.head(merged)
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 8)).astype(np.float32)
+    ys = (xs.sum(axis=1) > 0).astype(np.int64)
+
+    model = LoopNet()
+    opt = optim.Adam(learning_rate=0.05,
+                     parameters=model.parameters())
+    fwd = to_static(model.forward)
+    losses = []
+    for step in range(8):
+        logits = fwd(paddle.to_tensor(xs))
+        loss = F.cross_entropy(logits, paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_branch_local_temp_inside_for_loop():
+    """Review r4: the for->while synthesis must preserve node identity
+    for the liveness scan — a branch-local temp inside a for-body if
+    must NOT thread through lax.cond (it would surface UNDEF)."""
+    @to_static
+    def f(x):
+        for i in range(3):
+            if paddle.sum(x) > 0:
+                tmp = x + 1.0
+                x = tmp * 1.0
+            else:
+                x = x - 1.0
+        return x
+
+    np.testing.assert_allclose(
+        np.asarray(f(paddle.to_tensor(np.ones(2, np.float32)))._value),
+        4.0)
+    np.testing.assert_allclose(
+        np.asarray(f(paddle.to_tensor(-np.ones(2, np.float32)))._value),
+        -4.0)
+
+
+def test_call_inside_range_args_converts():
+    """Review r4: range() args are re-emitted as pre-statements; calls
+    inside them must still route through convert_call."""
+    @to_static
+    def f(x):
+        s = x * 0.0
+        for i in range(_r4_trip_count(x)):
+            s = s + x
+        return s
+
+    out = f(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(np.asarray(out._value), 6.0)
+
+
+def _r4_trip_count(x):
+    n = 0
+    while n < 2:
+        n = n + 1
+    return 4 + n  # 6
+
+
+def test_global_list_append_not_rebound():
+    """Review r4: append on a non-local name must stay a method call —
+    rebinding would shadow the global with UnboundLocalError."""
+    _R4_LOG.clear()
+
+    @to_static
+    def f(x):
+        _R4_LOG.append(1)
+        return x * 2.0
+
+    out = f(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(np.asarray(out._value), 2.0)
+    assert _R4_LOG == [1]
+
+
+_R4_LOG = []
+
+
+def test_tensor_array_overflow_raises_eagerly():
+    from paddle_tpu.jit.dy2static import TensorArray
+
+    ta = TensorArray(2, shape=(), dtype="float32")
+    ta = ta.append(1.0)
+    ta = ta.append(2.0)
+    with pytest.raises(IndexError, match="capacity"):
+        ta.append(3.0)

@@ -223,19 +223,32 @@ def test_static_while_passthrough_body_output():
 # -- honesty: knobs raise instead of lying ----------------------------------
 
 def test_strategy_dgc_localsgd_raise():
+    # r4: the refusal moved from the meta-optimizer chain to the
+    # assignment site — the closed schema rejects the knob immediately
     from paddle_tpu.distributed import fleet
-    from paddle_tpu.distributed.fleet.meta_optimizer_factory import (
-        apply_strategy)
-    import paddle_tpu.nn as nn
-    import paddle_tpu.optimizer as optim
 
-    model = nn.Linear(4, 4)
-    opt = optim.SGD(learning_rate=0.1, parameters=model.parameters())
     for knob in ("dgc", "localsgd", "adaptive_localsgd"):
         strategy = fleet.DistributedStrategy()
-        setattr(strategy, knob, True)
         with pytest.raises(NotImplementedError, match=knob):
-            apply_strategy(model, opt, strategy)
+            setattr(strategy, knob, True)
+        setattr(strategy, knob, False)  # falsy reset stays legal
+        assert getattr(strategy, knob) is False
+
+
+def test_strategy_closed_schema():
+    """r3 weak #4: unknown knobs must raise, not be swallowed
+    (distributed_strategy.proto closed-schema parity)."""
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    with pytest.raises(AttributeError, match="closed"):
+        s.a_sync_typo = True
+    with pytest.raises(ValueError, match="unknown config key"):
+        s.sharding_configs = {"stge": 2}
+    # implemented knobs still work, configs merge over defaults
+    s.a_sync = True
+    s.amp_configs = {"use_pure_fp16": True}
+    assert s.amp_configs["init_loss_scaling"] == 32768.0
 
 
 def test_group_sharded_offload_raises():
@@ -311,3 +324,37 @@ def test_strategy_amp_o1_wires_autocast():
     loss = step(x, y)
     assert np.isfinite(float(loss.item()))
     assert seen["dtype"] == jnp.bfloat16
+
+
+def test_strategy_configs_merge_over_current():
+    """Review r4: later config assignments update only the provided
+    keys (reference assign_configs_value), earlier settings survive."""
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.amp_configs = {"init_loss_scaling": 1024.0}
+    s.amp_configs = {"use_pure_fp16": True}
+    assert s.amp_configs["init_loss_scaling"] == 1024.0
+    assert s.amp_configs["use_pure_fp16"] is True
+
+
+def test_strategy_copy_pickle_roundtrip():
+    import copy
+    import pickle
+
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    s.amp = True
+    for clone in (copy.copy(s), copy.deepcopy(s),
+                  pickle.loads(pickle.dumps(s))):
+        assert clone.amp is True
+        assert clone.amp_configs["init_loss_scaling"] == 32768.0
+
+
+def test_strategy_unsupported_configs_read_as_dict():
+    from paddle_tpu.distributed import fleet
+
+    s = fleet.DistributedStrategy()
+    assert s.dgc_configs == {}
+    assert s.localsgd_configs.get("k_steps") is None
