@@ -34,22 +34,57 @@ import numpy as np
 BASELINES = {
     "gpt2_345m": 12000.0,
     "resnet50": 780.0,
+    "resnet50_pipeline": 780.0,
     "bert_base": 25000.0,
     "ernie": 25000.0,
     "mnist_lenet": 10000.0,
 }
 
 
+WINDOWS = 5  # median-of-k windows (r3 weak #1: single windows showed
+# ±20-80% cross-run spread through the tunnel; the median of five
+# independent windows is the recorded number and the spread is reported)
+
+
 def _measure(step, args, steps, warmup):
+    """Median of WINDOWS timing windows, `steps` timed steps each.
+    Returns (dt_per_step, first_loss, last_loss, window_dts)."""
     for _ in range(warmup):
         loss = step(*args)
     first = float(loss.item())
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(*args)
-    last = float(loss.item())  # .item() syncs
-    dt = (time.perf_counter() - t0) / steps
-    return dt, first, last
+    dts = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(*args)
+        last = float(loss.item())  # .item() syncs
+        dts.append((time.perf_counter() - t0) / steps)
+    return float(np.median(dts)), first, last, dts
+
+
+# peak bf16 chip throughput used for the MFU column. v5e ~197 TF/s
+# dense bf16; override for other chips via env.
+PEAK_TFLOPS = float(__import__("os").environ.get(
+    "BENCH_PEAK_TFLOPS", "197"))
+
+
+def _param_count(model):
+    return sum(int(np.prod(p.shape)) for p in model.parameters())
+
+
+def _mfu(flops_per_step, dt):
+    """Model FLOPs utilization against PEAK_TFLOPS. For transformers
+    flops = 6*N*tokens (param FLOPs, fwd+bwd); convnets use published
+    per-image forward GFLOPs x3."""
+    return round(flops_per_step / dt / (PEAK_TFLOPS * 1e12), 4)
+
+
+def _pack(value, unit, dts, mfu=None):
+    r = {"value": value, "unit": unit,
+         "window_spread": [round(d, 6) for d in dts]}
+    if mfu is not None:
+        r["mfu"] = mfu
+    return r
 
 
 def _check_decreasing(name, first, last):
@@ -67,9 +102,8 @@ def bench_mnist(on_tpu):
     from paddle_tpu.vision.models import LeNet
 
     # r3 probe: the step is host-latency-bound through the tunnel
-    # (B=256 step ~2.5 ms compute but high run-to-run jitter, 51k-102k
-    # imgs/s observed). B=1024 + 100 timed steps amortizes the jitter:
-    # ~270-296k imgs/s stable.
+    # (B=256 step ~2.5 ms compute but high run-to-run jitter). B=1024 +
+    # >=60 timed steps x 5 windows amortizes it (r3 weak #1).
     paddle.seed(0)
     batch = 1024 if on_tpu else 32
     steps, warmup = (100, 5) if on_tpu else (3, 1)
@@ -81,9 +115,11 @@ def bench_mnist(on_tpu):
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(batch, 1, 28, 28).astype(np.float32))
     y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
-    dt, first, last = _measure(step, (x, y), steps, warmup)
+    dt, first, last, dts = _measure(step, (x, y), steps, warmup)
     _check_decreasing("mnist", first, last)
-    return {"value": round(batch / dt, 1), "unit": "imgs/s"}
+    # LeNet fwd ~= 0.00042 GF/img (published MACs x2), fwd+bwd ~3x
+    return _pack(round(batch / dt, 1), "imgs/s", dts,
+                 _mfu(3 * 0.00042e9 * batch, dt))
 
 
 def bench_resnet50(on_tpu):
@@ -103,7 +139,8 @@ def bench_resnet50(on_tpu):
     paddle.seed(0)
     batch = 128 if on_tpu else 2
     size = 224 if on_tpu else 32
-    steps, warmup = (20, 3) if on_tpu else (2, 1)
+    steps, warmup = (60, 5) if on_tpu else (2, 1)  # r3 weak #1: 20
+    # timed steps was inside the jitter envelope; 60 x 5 windows
     net = resnet50()
     if on_tpu:
         net = amp.decorate(net, level="O2", dtype="bfloat16")
@@ -120,9 +157,127 @@ def bench_resnet50(on_tpu):
         rng.randn(batch, 3, size, size).astype(np.float32))
     x._value = x._value.astype(dt_in)
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
-    dt, first, last = _measure(step, (x, y), steps, warmup)
+    dt, first, last, dts = _measure(step, (x, y), steps, warmup)
     _check_decreasing("resnet50", first, last)
-    return {"value": round(batch / dt, 1), "unit": "imgs/s"}
+    # ResNet-50 fwd 4.09 GF/img at 224x224 (published), fwd+bwd ~3x
+    return _pack(round(batch / dt, 1), "imgs/s", dts,
+                 _mfu(3 * 4.09e9 * batch, dt))
+
+
+class _SynthImageNet:
+    """ImageNet-shaped synthetic dataset for the pipeline-fed bench:
+    one preallocated image per worker (index-cheap __getitem__), so
+    the measured cost is collation + shm-ring transport + H2D — the
+    DataLoader machinery itself — not numpy RNG throughput."""
+
+    def __init__(self, n, size):
+        rng = np.random.RandomState(0)
+        self.n = n
+        self.base = rng.randn(3, size, size).astype(np.float32)
+        self.labels = rng.randint(0, 1000, (n,)).astype(np.int64)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return self.base, self.labels[i]
+
+
+def bench_resnet50_pipeline(on_tpu):
+    """r3 weak #3: every config reused one device-resident batch, so
+    the shm-ring DataLoader was never shown to sustain bench
+    throughput.
+
+    Two measurements:
+      * loader_imgs_s — multiprocess DataLoader (4 workers, shm rings)
+        delivering ImageNet-shaped f32 batches to the host trainer
+        loop, NO device step. The claim "the input pipeline sustains
+        the synthetic step rate" holds iff this >= the resnet50
+        config's imgs/s.
+      * value (e2e imgs/s) — the same loader FEEDING the compiled
+        step. In this harness the chip sits behind a network tunnel,
+        so per-step H2D of a 77 MB batch is tunnel-bound (seconds) —
+        an environment artifact, not a framework cost: on locally
+        attached TPU, PCIe moves 77 MB in ~5 ms against a ~60 ms
+        step. The loader_imgs_s row is the framework claim; the e2e
+        row records the harness reality.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    batch = 128 if on_tpu else 2
+    size = 224 if on_tpu else 32
+    net = resnet50()
+    if on_tpu:
+        net = amp.decorate(net, level="O2", dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=net.parameters(),
+                         multi_precision=on_tpu)
+    step = TrainStepCompiler(net, opt, lambda o, y: ce(o, y))
+    import os
+
+    import jax.numpy as jnp
+
+    dt_in = jnp.bfloat16 if on_tpu else jnp.float32
+    # 128x3x224x224 f32 = 77 MB/batch: needs a bigger shm-ring slot
+    # than the 64 MB default
+    os.environ.setdefault("FLAGS_dataloader_shm_slot_mb", "128")
+    n_loader = 40 if on_tpu else 4
+    warm_l = 5 if on_tpu else 1
+    ds = _SynthImageNet((n_loader + warm_l) * batch, size)
+    loader = DataLoader(ds, batch_size=batch, num_workers=4,
+                        use_shared_memory=True, drop_last=True,
+                        persistent_workers=True)
+    # (1) loader-only host delivery rate
+    it = iter(loader)
+    for _ in range(warm_l):
+        next(it)
+    t0 = time.perf_counter()
+    got = 0
+    for x, y in it:
+        got += 1
+    loader_dt = (time.perf_counter() - t0) / max(got, 1)
+    loader_rate = round(batch / loader_dt, 1)
+    # (2) e2e: loader feeding the compiled step (few steps — each
+    # carries a tunnel-bound 77 MB H2D in this harness)
+    steps, warmup, windows = (4, 1, 2) if on_tpu else (2, 1, 1)
+    it = iter(loader)
+    dts = []
+
+    def _next_step():
+        nonlocal it
+        try:
+            x, y = next(it)
+        except StopIteration:
+            it = iter(loader)
+            x, y = next(it)
+        x._value = x._value.astype(dt_in)
+        return step(x, y)
+
+    for _ in range(warmup):
+        loss = _next_step()
+    first = float(loss.item())
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = _next_step()
+        last = float(loss.item())
+        dts.append((time.perf_counter() - t0) / steps)
+    _check_decreasing("resnet50_pipeline", first, last)
+    dt = float(np.median(dts))
+    r = _pack(round(batch / dt, 1), "imgs/s", dts)
+    r["loader_imgs_s"] = loader_rate
+    r["note"] = ("loader_imgs_s is the framework claim (input pipeline "
+                 "sustains the synthetic rate); e2e value is "
+                 "tunnel-H2D-bound in this harness")
+    return r
 
 
 def bench_bert(on_tpu):
@@ -167,9 +322,10 @@ def bench_bert(on_tpu):
                                        (batch, seq)).astype(np.int64))
     step = TrainStepCompiler(model, opt, loss_fn=None)
     tt = paddle.to_tensor(np.zeros((batch, seq), np.int64))
-    dt, first, last = _measure(step, (ids, tt, ids), steps, warmup)
+    dt, first, last, dts = _measure(step, (ids, tt, ids), steps, warmup)
     _check_decreasing("bert", first, last)
-    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
+    return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
+                 _mfu(6 * _param_count(model) * batch * seq, dt))
 
 
 def bench_gpt2(on_tpu):
@@ -191,7 +347,7 @@ def bench_gpt2(on_tpu):
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, ffn_hidden=4096, max_seq_len=1024,
                         dropout=0.0, remat=False, use_flash_attention=True)
-        batch, seq, steps, warmup = 4, 1024, 20, 3
+        batch, seq, steps, warmup = 4, 1024, 20, 3  # x5 windows
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, ffn_hidden=256, max_seq_len=128,
@@ -209,9 +365,10 @@ def bench_gpt2(on_tpu):
                                        (batch, seq)).astype(np.int32))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                           (batch, seq)).astype(np.int32))
-    dt, first, last = _measure(step, (ids, labels), steps, warmup)
+    dt, first, last, dts = _measure(step, (ids, labels), steps, warmup)
     _check_decreasing("gpt2", first, last)
-    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
+    return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
+                 _mfu(6 * _param_count(model) * batch * seq, dt))
 
 
 def bench_ernie(on_tpu):
@@ -255,10 +412,11 @@ def bench_ernie(on_tpu):
                                        (batch, seq)).astype(np.int64))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                           (batch, seq)).astype(np.int64))
-    dt, first, last = _measure(step, (ids, labels), steps, warmup)
+    dt, first, last, dts = _measure(step, (ids, labels), steps, warmup)
     _check_decreasing("ernie", first, last)
     set_mesh(None)
-    return {"value": round(batch * seq / dt, 1), "unit": "tokens/s"}
+    return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
+                 _mfu(6 * _param_count(model) * batch * seq, dt))
 
 
 def main():
@@ -268,6 +426,7 @@ def main():
     suite = {
         "mnist_lenet": bench_mnist,
         "resnet50": bench_resnet50,
+        "resnet50_pipeline": bench_resnet50_pipeline,
         "bert_base": bench_bert,
         "gpt2_345m": bench_gpt2,
         "ernie": bench_ernie,

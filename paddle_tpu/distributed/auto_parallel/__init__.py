@@ -208,7 +208,71 @@ class Engine:
         self._strategy = strategy
         self._step = None
 
-    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                auto=False, sample_batch=None, n_devices=None,
+                constraints=None, verbose=False):
+        """With auto=True (or strategy.auto/auto_search set): run the
+        mesh-factorization planner (planner.py) over the available
+        devices, adopt the best-scoring mesh, and compile the step on
+        it. Needs `sample_batch` (tiny example tensors) to lower the
+        candidates for XLA cost analysis."""
+        want_auto = auto or bool(
+            self._strategy is not None
+            and (getattr(self._strategy, "auto", False)
+                 or getattr(self._strategy, "auto_search", False)))
+        if not want_auto:
+            return self
+        if sample_batch is None:
+            raise ValueError(
+                "Engine.prepare(auto=True) needs sample_batch=(inputs, "
+                "labels) to lower candidate meshes for cost analysis")
+        import jax as _jax
+
+        from ...jit.distributed import DistributedTrainStepCompiler
+        from .. import mesh as mesh_mod
+        from .planner import Planner, xla_cost_of_step
+
+        devs = _jax.devices()
+        n = n_devices or len(devs)
+        param_bytes = float(sum(
+            int(np.prod(p.shape)) * int(jax.numpy.dtype(p.dtype).itemsize)
+            for p in self._model.parameters()))
+        batch_n = int(sample_batch[0].shape[0])
+        cons = dict(constraints or {})
+        # pp re-cuts the MODEL (pipeline stages live in model configs,
+        # not the compiler), so a prepared Engine searches dp/mp/
+        # sharding/sp only unless the caller widens it
+        cons.setdefault("pp", 1)
+        cons.setdefault("dp", lambda d: batch_n % d == 0)
+        cons.setdefault("sharding", lambda d: batch_n % d == 0)
+        loss_fn = ((lambda out, lbl: self._loss(out, lbl))
+                   if self._loss is not None else None)
+
+        def evaluate(axes):
+            sizes = {a: axes.get(a, 1) for a in
+                     ("dp", "mp", "pp", "sharding", "sp")}
+            mesh = mesh_mod.build_mesh(sizes, devices=devs[:n])
+            mesh_mod.set_mesh(mesh)
+            step = DistributedTrainStepCompiler(
+                self._model, self._optimizer, loss_fn=loss_fn,
+                mesh=mesh, donate=False)
+            cost = xla_cost_of_step(step, sample_batch)
+            cost["param_bytes"] = param_bytes
+            return cost
+
+        planner = Planner(n, evaluate, constraints=cons)
+        est, best_axes, _cost = planner.best(verbose=verbose)
+        self.plan_result = (est, best_axes)
+        sizes = {a: best_axes.get(a, 1) for a in
+                 ("dp", "mp", "pp", "sharding", "sp")}
+        mesh = mesh_mod.build_mesh(sizes, devices=devs[:n])
+        mesh_mod.set_mesh(mesh)
+        self._planned_mesh = mesh
+        if verbose:
+            print(f"[planner] adopted mesh {best_axes or '{serial}'} "
+                  f"(est {est * 1e3:.3f} ms/step)")
+        self._step = DistributedTrainStepCompiler(
+            self._model, self._optimizer, loss_fn=loss_fn, mesh=mesh)
         return self
 
     def _ensure_step(self):

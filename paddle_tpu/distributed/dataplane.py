@@ -1,0 +1,149 @@
+"""Direct-socket data plane for eager p2p / large payloads.
+
+Parity target: the reference's split between rendezvous and data —
+`platform/gen_comm_id_helper.cc:36` moves only comm IDs through the
+bootstrap store, then NCCL sockets/IB move tensors. Round 3 shipped
+eager `dist.send/recv` as base64 pickle THROUGH the rank-0 KV store
+(store_collective.py) — correct, but O(n) encoded copies through one
+single-threaded server (r3 weak #5). Here the store keeps its
+rendezvous role (each rank publishes its data-plane endpoint under
+`dp/{rank}`) and tensor bytes move point-to-point over TCP.
+
+Framing: 4-byte length + pickle protocol 5 (numpy buffers serialize as
+single contiguous copies). Receivers demux frames into per-(src, tag)
+inboxes keyed by sequence number, so interleaved edges never collide
+and out-of-order delivery (multiple sender threads) is reordered by
+seq at the receiver.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["DataPlane"]
+
+
+def _send_frame(sock_file, obj):
+    payload = pickle.dumps(obj, protocol=5)
+    sock_file.write(struct.pack("<Q", len(payload)) + payload)
+    sock_file.flush()
+
+
+def _recv_frame(sock_file):
+    hdr = sock_file.read(8)
+    if len(hdr) < 8:
+        raise ConnectionError("peer closed")
+    (n,) = struct.unpack("<Q", hdr)
+    buf = sock_file.read(n)
+    if len(buf) < n:
+        raise ConnectionError("truncated frame")
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        dp = self.server.dataplane
+        while True:
+            try:
+                frame = _recv_frame(self.rfile)
+            except (ConnectionError, EOFError, OSError):
+                return
+            dp._deliver(frame)
+
+
+class DataPlane:
+    """One per process: a listener for inbound tensors + cached
+    outbound connections."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), _Handler)
+        self._server.dataplane = self
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self._inbox = {}          # (src, tag) -> {seq: ndarray}
+        self._cv = threading.Condition()
+        self._conns = {}          # endpoint -> socket file
+        self._conn_locks = {}     # endpoint -> lock
+        self._glock = threading.Lock()
+        self.sends = 0            # diagnostics (tests assert the
+        self.recvs = 0            # socket path actually carried data)
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    # -- receive side --------------------------------------------------
+    def _deliver(self, frame):
+        arr = np.frombuffer(frame["data"],
+                            dtype=frame["dt"]).reshape(frame["sh"])
+        key = (int(frame["src"]), frame["tag"])
+        with self._cv:
+            self._inbox.setdefault(key, {})[int(frame["seq"])] = arr
+            self._cv.notify_all()
+
+    def recv(self, src, tag, seq, timeout=180.0):
+        key = (int(src), tag)
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: int(seq) in self._inbox.get(key, {}),
+                timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"dataplane recv timeout: src={src} tag={tag} "
+                    f"seq={seq}")
+            arr = self._inbox[key].pop(int(seq))
+            self.recvs += 1
+            return arr.copy()  # frombuffer views the frame; detach
+
+    # -- send side ------------------------------------------------------
+    def _conn(self, endpoint):
+        with self._glock:
+            lock = self._conn_locks.setdefault(endpoint,
+                                               threading.Lock())
+        with lock:
+            f = self._conns.get(endpoint)
+            if f is None:
+                host, port = endpoint.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                f = s.makefile("wb")
+                self._conns[endpoint] = f
+        return lock, f
+
+    def send(self, endpoint, src, tag, seq, arr, timeout=180.0):
+        arr = np.ascontiguousarray(arr)
+        lock, f = self._conn(endpoint)
+        frame = {"src": int(src), "tag": tag, "seq": int(seq),
+                 "dt": str(arr.dtype), "sh": list(arr.shape),
+                 "data": arr.tobytes()}
+        with lock:
+            try:
+                _send_frame(f, frame)
+            except (OSError, ConnectionError):
+                # reconnect once (receiver may have restarted)
+                with self._glock:
+                    self._conns.pop(endpoint, None)
+                lock2, f2 = self._conn(endpoint)
+                _send_frame(f2, frame)
+        self.sends += 1
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        for f in self._conns.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._conns.clear()

@@ -25,8 +25,9 @@ import threading
 
 import numpy as np
 
-__all__ = ["DenseTable", "SparseTable", "PSServer", "PSClient",
-           "AsyncCommunicator", "DistributedEmbedding"]
+__all__ = ["DenseTable", "SparseTable", "SSDSparseTable", "PSServer",
+           "PSClient", "AsyncCommunicator", "GeoCommunicator",
+           "DistributedEmbedding"]
 
 
 # ---------------------------------------------------------------------------
@@ -110,6 +111,19 @@ class SparseTable:
                 else:
                     row -= lr * g
 
+    def apply_delta(self, ids, deltas):
+        """Additive merge (geo-SGD sync: concurrent trainers' deltas
+        sum — reference communicator.h GeoCommunicator semantics)."""
+        deltas = np.asarray(deltas, np.float32)
+        with self._lock:
+            for _id, d in zip(ids, deltas):
+                _id = int(_id)
+                row = self._rows.get(_id)
+                if row is None:
+                    row = self._init_row(_id)
+                    self._rows[_id] = row
+                row += d
+
     def size(self):
         with self._lock:
             return len(self._rows)
@@ -131,6 +145,209 @@ class SparseTable:
             self._acc = dict(st.get("acc", {}))
 
 
+class _DiskRowStore:
+    """Append-log row store with an in-memory offset index — the
+    rocksdb stand-in behind SSDSparseTable (reference
+    ssd_sparse_table.cc pairs an in-memory LRU with rocksdb; here the
+    log holds pickled (row, acc) records, stale versions are left
+    behind on overwrite and reclaimed by compaction when the file
+    exceeds 2x the live volume)."""
+
+    def __init__(self, path=None):
+        import os
+        import tempfile
+
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="ps_ssd_", suffix=".log")
+            os.close(fd)
+        self.path = path
+        self._f = open(path, "w+b")
+        self._index = {}       # id -> (offset, length)
+        self._live_bytes = 0
+        self._total_bytes = 0
+
+    def put(self, _id, obj):
+        payload = pickle.dumps(obj, protocol=5)
+        self._f.seek(0, 2)
+        off = self._f.tell()
+        self._f.write(payload)
+        old = self._index.get(_id)
+        if old is not None:
+            self._live_bytes -= old[1]
+        self._index[_id] = (off, len(payload))
+        self._live_bytes += len(payload)
+        self._total_bytes = off + len(payload)
+        if self._total_bytes > 2 * self._live_bytes + (1 << 16):
+            self._compact()
+
+    def get(self, _id):
+        ent = self._index.get(_id)
+        if ent is None:
+            return None
+        off, n = ent
+        self._f.seek(off)
+        return pickle.loads(self._f.read(n))
+
+    def pop(self, _id):
+        obj = self.get(_id)
+        if obj is not None:
+            off, n = self._index.pop(_id)
+            self._live_bytes -= n
+        return obj
+
+    def __contains__(self, _id):
+        return _id in self._index
+
+    def __len__(self):
+        return len(self._index)
+
+    def keys(self):
+        return list(self._index.keys())
+
+    def _compact(self):
+        live = [(k, self.get(k)) for k in self._index]
+        self._f.seek(0)
+        self._f.truncate()
+        self._index.clear()
+        self._live_bytes = self._total_bytes = 0
+        for k, obj in live:
+            self.put(k, obj)
+
+    def close(self):
+        import os
+
+        try:
+            self._f.close()
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class SSDSparseTable(SparseTable):
+    """Disk-spill sparse table (reference ssd_sparse_table.cc): a hot
+    LRU set of rows lives in memory (`mem_budget_rows`); colder rows —
+    with their optimizer accumulators — spill to the append-log disk
+    store and fault back in on access. This is what makes
+    "terabyte embeddings" literal: the memory footprint is bounded by
+    the budget, the table by the disk."""
+
+    def __init__(self, emb_dim, mem_budget_rows=100000, disk_path=None,
+                 **kw):
+        super().__init__(emb_dim, **kw)
+        import collections as _c
+
+        self.mem_budget_rows = int(mem_budget_rows)
+        self._rows = _c.OrderedDict()   # LRU: most-recent at the end
+        self._disk = _DiskRowStore(disk_path)
+        self._spills = 0
+        self._faults = 0
+
+    # -- internal: LRU + fault-in ------------------------------------
+    def _touch(self, _id):
+        self._rows.move_to_end(_id)
+
+    def _load_or_init(self, _id):
+        """Row into memory (faulting from disk or initializing),
+        evicting over-budget LRU rows to disk. Caller holds _lock."""
+        row = self._rows.get(_id)
+        if row is not None:
+            self._touch(_id)
+            return row
+        rec = self._disk.pop(_id)
+        if rec is not None:
+            row, acc = rec
+            self._faults += 1
+            if acc is not None:
+                self._acc[_id] = acc
+        else:
+            row = self._init_row(_id)
+        self._rows[_id] = row
+        self._evict_over_budget()
+        return row
+
+    def _evict_over_budget(self):
+        while len(self._rows) > self.mem_budget_rows:
+            old_id, old_row = self._rows.popitem(last=False)
+            self._disk.put(old_id, (old_row,
+                                    self._acc.pop(old_id, None)))
+            self._spills += 1
+
+    def pull(self, ids):
+        with self._lock:
+            out = np.empty((len(ids), self.emb_dim), np.float32)
+            for i, _id in enumerate(ids):
+                out[i] = self._load_or_init(int(_id))
+            return out
+
+    def push_grad(self, ids, grads, lr=None):
+        lr = lr if lr is not None else self.lr
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for _id, g in zip(ids, grads):
+                _id = int(_id)
+                row = self._load_or_init(_id)
+                if self.optimizer == "adagrad":
+                    acc = self._acc.setdefault(
+                        _id, np.full(self.emb_dim, 1e-6, np.float32))
+                    acc += g * g
+                    row -= lr * g / np.sqrt(acc)
+                else:
+                    row -= lr * g
+
+    def apply_delta(self, ids, deltas):
+        deltas = np.asarray(deltas, np.float32)
+        with self._lock:
+            for _id, d in zip(ids, deltas):
+                self._load_or_init(int(_id))[:] += d
+
+    def size(self):
+        with self._lock:
+            return len(self._rows) + len(self._disk)
+
+    def mem_rows(self):
+        with self._lock:
+            return len(self._rows)
+
+    def disk_rows(self):
+        with self._lock:
+            return len(self._disk)
+
+    def spill_stats(self):
+        with self._lock:
+            return {"spills": self._spills, "faults": self._faults,
+                    "mem_rows": len(self._rows),
+                    "disk_rows": len(self._disk)}
+
+    def config(self):
+        c = super().config()
+        c["mem_budget_rows"] = self.mem_budget_rows
+        c["table_class"] = "ssd"
+        return c
+
+    def state(self):
+        with self._lock:
+            rows = dict(self._rows)
+            acc = dict(self._acc)
+            for _id in self._disk.keys():
+                row, a = self._disk.get(_id)
+                rows[_id] = row
+                if a is not None:
+                    acc[_id] = a
+            return {"rows": rows, "acc": acc, "config": self.config()}
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows.clear()
+            self._disk.close()
+            self._disk = _DiskRowStore()
+            self._acc = {int(k): np.asarray(v, np.float32)
+                         for k, v in st.get("acc", {}).items()}
+            # route through the LRU so over-budget rows spill on load
+            for _id, row in st["rows"].items():
+                self._rows[int(_id)] = np.asarray(row, np.float32)
+                self._evict_over_budget()
+
+
 # ---------------------------------------------------------------------------
 # RPC transport (brpc stand-in): 4-byte length + pickle
 # ---------------------------------------------------------------------------
@@ -147,6 +364,15 @@ def _recv_msg(sock_file):
         raise ConnectionError("peer closed")
     (n,) = struct.unpack("<I", hdr)
     return pickle.loads(sock_file.read(n))
+
+
+def _make_sparse_table(emb_dim, table_class=None, **kw):
+    """Table factory (reference table registry: table_class in the
+    proto selects MemorySparseTable vs SSDSparseTable)."""
+    if table_class in ("ssd", "SSDSparseTable"):
+        return SSDSparseTable(emb_dim, **kw)
+    kw.pop("mem_budget_rows", None)
+    return SparseTable(emb_dim, **kw)
 
 
 class _PSHandler(socketserver.StreamRequestHandler):
@@ -197,7 +423,7 @@ class PSServer:
         self._dense[name] = DenseTable(shape, initializer, lr)
 
     def create_sparse_table(self, name, emb_dim, **kw):
-        self._sparse[name] = SparseTable(emb_dim, **kw)
+        self._sparse[name] = _make_sparse_table(emb_dim, **kw)
 
     def _dispatch(self, req):
         op = req["op"]
@@ -208,7 +434,11 @@ class PSServer:
                                                 req.get("lr"))
             return {"ok": True}
         if op == "set_dense":
-            self._dense[req["table"]].set(req["value"])
+            tbl = self._dense.get(req["table"])
+            if tbl is None:  # auto-create (dataset shuffle buckets etc.)
+                tbl = DenseTable(np.shape(req["value"]))
+                self._dense[req["table"]] = tbl
+            tbl.set(req["value"])
             return {"ok": True}
         if op == "pull_sparse":
             return {"ok": True,
@@ -217,6 +447,16 @@ class PSServer:
             self._sparse[req["table"]].push_grad(req["ids"], req["grads"],
                                                  req.get("lr"))
             return {"ok": True}
+        if op == "push_sparse_delta":
+            self._sparse[req["table"]].apply_delta(req["ids"],
+                                                   req["deltas"])
+            return {"ok": True}
+        if op == "sparse_stats":
+            tbl = self._sparse[req["table"]]
+            stats = (tbl.spill_stats() if hasattr(tbl, "spill_stats")
+                     else {"mem_rows": tbl.size(), "disk_rows": 0,
+                           "spills": 0, "faults": 0})
+            return {"ok": True, "value": stats}
         if op == "create_dense":
             self.create_dense_table(req["table"], req["shape"],
                                     req.get("initializer"),
@@ -259,8 +499,8 @@ class PSServer:
                 if tbl is None:
                     # rebuild with the SAVED hyperparameters — a
                     # default-constructed table would silently change
-                    # the optimizer rule/lr after restore
-                    tbl = SparseTable(**st["config"])
+                    # the optimizer rule/lr/table class after restore
+                    tbl = _make_sparse_table(**st["config"])
                     self._sparse[k] = tbl
                 tbl.load_state(st)
             return {"ok": True}
@@ -383,9 +623,29 @@ class PSClient:
                            "ids": ids[idx].tolist(),
                            "grads": grads[idx], "lr": lr})
 
+    def push_sparse_delta(self, table, ids, deltas):
+        ids, srv = self._shard(ids)
+        deltas = np.asarray(deltas, np.float32)
+        for s in range(self.num_servers):
+            idx = np.nonzero(srv == s)[0]
+            if len(idx) == 0:
+                continue
+            self._call(s, {"op": "push_sparse_delta", "table": table,
+                           "ids": ids[idx].tolist(),
+                           "deltas": deltas[idx]})
+
     def sparse_size(self, table):
         return sum(self._call(s, {"op": "sparse_size", "table": table})
                    for s in range(self.num_servers))
+
+    def sparse_stats(self, table):
+        """Aggregated spill/residency stats across shards."""
+        agg = {"spills": 0, "faults": 0, "mem_rows": 0, "disk_rows": 0}
+        for s in range(self.num_servers):
+            st = self._call(s, {"op": "sparse_stats", "table": table})
+            for k in agg:
+                agg[k] += st.get(k, 0)
+        return agg
 
     def save(self, path):
         for s in range(self.num_servers):
@@ -457,6 +717,73 @@ class AsyncCommunicator:
         self.flush()
 
 
+class GeoCommunicator:
+    """Geo-async SGD (reference ps/service/communicator/
+    communicator.h GeoCommunicator): the trainer optimizes a LOCAL
+    copy of the touched rows; every `geo_step` steps the accumulated
+    deltas (local - base) ship to the PS as an ADDITIVE merge and the
+    fresh global rows come back — so concurrent trainers' progress
+    sums instead of racing, and the worker never blocks on a PS
+    round-trip inside a step."""
+
+    def __init__(self, client, table, geo_step=4):
+        self._client = client
+        self._table = table
+        self.geo_step = int(geo_step)
+        self._local = {}   # id -> local row (trainer-side truth)
+        self._base = {}    # id -> value at last sync (delta reference)
+        self._touched = set()
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def pull(self, ids):
+        """Rows from the LOCAL cache, faulting misses from the PS."""
+        ids = np.asarray(ids, np.int64).ravel()
+        with self._lock:
+            missing = [int(i) for i in ids if int(i) not in self._local]
+            if missing:
+                rows = self._client.pull_sparse(self._table, missing)
+                for i, r in zip(missing, rows):
+                    self._local[i] = r.copy()
+                    self._base[i] = r.copy()
+            return np.stack([self._local[int(i)] for i in ids])
+
+    def update(self, ids, grads, lr):
+        """Local SGD on the cached rows (no PS traffic)."""
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, g in zip(np.asarray(ids, np.int64).ravel(), grads):
+                i = int(i)
+                self._local[i] -= lr * g
+                self._touched.add(i)
+
+    def step(self):
+        """Call once per optimizer step; syncs every geo_step-th."""
+        self._step += 1
+        if self._step % self.geo_step == 0:
+            self.sync()
+
+    def sync(self):
+        # the lock spans the WHOLE round trip: a concurrent update()
+        # between the delta snapshot and the local re-base would be
+        # overwritten by the fresh pull — its gradient lost without
+        # ever shipping (review r4). Geo syncs are rare (every
+        # geo_step), so blocking concurrent updaters for one RPC pair
+        # is the correct trade.
+        with self._lock:
+            touched = sorted(self._touched)
+            self._touched.clear()
+            if not touched:
+                return
+            deltas = np.stack([self._local[i] - self._base[i]
+                               for i in touched])
+            self._client.push_sparse_delta(self._table, touched, deltas)
+            fresh = self._client.pull_sparse(self._table, touched)
+            for i, r in zip(touched, fresh):
+                self._local[i] = r.copy()
+                self._base[i] = r.copy()
+
+
 class DistributedEmbedding:
     """Worker-side embedding over a PS sparse table (reference
     distributed lookup_table / c_embedding-over-PS): pull rows for the
@@ -488,10 +815,11 @@ class DistributedEmbedding:
                 f"embedding id out of range [0, {self.num_embeddings}): "
                 f"min={flat.min()}, max={flat.max()}")
         uniq, inverse = np.unique(flat, return_inverse=True)
-        rows = self._client.pull_sparse(self._table, uniq)
-
         client, table, lr, comm = (self._client, self._table, self.lr,
                                    self._comm)
+        geo = isinstance(comm, GeoCommunicator)
+        rows = comm.pull(uniq) if geo \
+            else client.pull_sparse(table, uniq)
 
         def _k(rows_v, inv):
             return jnp.take(rows_v, inv, axis=0)
@@ -506,7 +834,9 @@ class DistributedEmbedding:
         def push(grad):
             g = np.asarray(grad._value if hasattr(grad, "_value")
                            else grad)
-            if comm is not None:
+            if geo:
+                comm.update(uniq, g, lr)  # local; ships on geo sync
+            elif comm is not None:
                 comm.push_sparse_async(table, uniq, g, lr=lr)
             else:
                 client.push_sparse(table, uniq, g, lr=lr)

@@ -1,0 +1,171 @@
+"""InMemoryDataset / data_feed — industrial file ingest for the PS
+trainer family.
+
+Parity target: paddle/fluid/framework/data_set.cc (InMemoryDataset:
+load_into_memory -> local/global shuffle -> feed trainer threads) and
+data_feed.cc (MultiSlotDataFeed: line -> slots parsing).
+
+TPU-native scope: the trainer family here drives CPU-side CTR
+workloads (the dense model trains on-chip separately), so ingest is
+host numpy. Files parse in a thread pool with a pluggable `parse_fn`
+(line -> sample; the MultiSlotDataFeed wire format gets a ready-made
+parser below). Global shuffle follows the reference's two designs:
+
+  * hash partition (`global_shuffle(trainer_id, trainer_num)` when
+    every trainer loads the same file list) — sample-hash modulo
+    assigns each record to exactly one trainer, then local shuffle;
+  * PS-routed exchange (`global_shuffle_via_ps`) when trainers hold
+    DISJOINT file sets: each trainer pushes its samples to the PS
+    server keyed by destination trainer (data moves, like the
+    reference's send_shuffle_data), then pulls its bucket.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import hashlib
+import pickle
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset", "multi_slot_parser"]
+
+
+def multi_slot_parser(slot_names, slot_types=None):
+    """MultiSlotDataFeed line format (data_feed.cc): per slot,
+    `<n> v1 ... vn` repeated for each slot in order. Returns a
+    parse_fn producing a dict {slot: np.ndarray}."""
+    slot_types = slot_types or ["int64"] * len(slot_names)
+
+    def parse(line):
+        toks = line.split()
+        out = {}
+        i = 0
+        for name, ty in zip(slot_names, slot_types):
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            i += n
+            out[name] = np.asarray(
+                vals, np.int64 if ty in ("int64", "int") else np.float32)
+        return out
+
+    return parse
+
+
+class InMemoryDataset:
+    """data_set.cc InMemoryDataset analog."""
+
+    def __init__(self, batch_size=32, thread_num=4, parse_fn=None):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.parse_fn = parse_fn or (lambda line: line)
+        self._samples = []
+        self._filelist = []
+
+    # -- reference API names -----------------------------------------
+    def init(self, batch_size=None, thread_num=None, parse_fn=None,
+             **kw):
+        if batch_size is not None:
+            self.batch_size = batch_size
+        if thread_num is not None:
+            self.thread_num = thread_num
+        if parse_fn is not None:
+            self.parse_fn = parse_fn
+        return self
+
+    def set_filelist(self, files):
+        self._filelist = list(files)
+
+    def load_into_memory(self, files=None):
+        """Parse files into the in-memory sample list using a thread
+        pool (data_feed threads)."""
+        files = list(files) if files is not None else self._filelist
+        self._filelist = files
+
+        def load_one(path):
+            out = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(self.parse_fn(line))
+            return out
+
+        with _fut.ThreadPoolExecutor(self.thread_num) as pool:
+            for chunk in pool.map(load_one, files):
+                self._samples.extend(chunk)
+        return len(self._samples)
+
+    def memory_size(self):
+        return len(self._samples)
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._samples)
+
+    @staticmethod
+    def _sample_hash(sample):
+        return int(hashlib.md5(
+            pickle.dumps(sample, protocol=5)).hexdigest()[:8], 16)
+
+    def global_shuffle(self, trainer_id=0, trainer_num=1, seed=0):
+        """Hash-partition global shuffle: with every trainer holding
+        the SAME loaded file list, keep only the samples whose content
+        hash lands on this trainer, then shuffle locally. Across
+        trainers the kept sets are disjoint and complete — the
+        reference's global shuffle postcondition — with zero data
+        motion."""
+        if trainer_num > 1:
+            self._samples = [s for s in self._samples
+                             if self._sample_hash(s) % trainer_num
+                             == trainer_id]
+        self.local_shuffle(seed=seed + trainer_id)
+        return len(self._samples)
+
+    def global_shuffle_via_ps(self, client, table, trainer_id,
+                              trainer_num, world_key="ds_shuffle",
+                              seed=0, timeout=60.0):
+        """Data-moving global shuffle for DISJOINT per-trainer file
+        sets (reference send_shuffle_data path): push each sample to
+        the PS dense bucket of its destination trainer, barrier, pull
+        this trainer's bucket back."""
+        buckets = [[] for _ in range(trainer_num)]
+        for s in self._samples:
+            buckets[self._sample_hash(s) % trainer_num].append(s)
+        for dst in range(trainer_num):
+            payload = np.frombuffer(
+                pickle.dumps(buckets[dst], protocol=5), np.uint8)
+            client.set_dense(f"{table}/shuf/{trainer_id}->{dst}",
+                             payload)
+        client.barrier(world_key + "/pushed", trainer_num,
+                       timeout=timeout)
+        merged = []
+        for src in range(trainer_num):
+            raw = client.pull_dense(f"{table}/shuf/{src}->{trainer_id}")
+            merged.extend(pickle.loads(np.asarray(
+                raw, np.uint8).tobytes()))
+        self._samples = merged
+        self.local_shuffle(seed=seed + trainer_id)
+        client.barrier(world_key + "/pulled", trainer_num,
+                       timeout=timeout)
+        return len(self._samples)
+
+    def batches(self, drop_last=False):
+        bs = self.batch_size
+        n = len(self._samples)
+        end = n - (n % bs) if drop_last else n
+        for i in range(0, end, bs):
+            yield self._samples[i:i + bs]
+
+    def release_memory(self):
+        self._samples = []
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset: no global shuffle,
+    files stream through once)."""
+
+    def global_shuffle(self, *a, **kw):
+        raise NotImplementedError(
+            "QueueDataset streams files; use InMemoryDataset for "
+            "global shuffle (data_set.cc draws the same line)")

@@ -107,6 +107,12 @@ class DownpourTrainer(HogwildTrainer):
         else:
             self.client.push_sparse(table, ids, grads, lr=lr)
 
+    def train_from_dataset(self, dataset, train_fn, timeout=None):
+        """exe.train_from_dataset analog: consume an InMemoryDataset's
+        batches across the worker threads (data_set.cc ->
+        device_worker feed loop)."""
+        return self.run(dataset.batches(), train_fn).finalize(timeout)
+
     def finalize(self, timeout=None):
         try:
             super().finalize(timeout)

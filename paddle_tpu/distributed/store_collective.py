@@ -106,10 +106,34 @@ def _dec(obj):
     return a.reshape(obj["sh"]).copy()
 
 
+# payloads above this ride the socket data plane instead of the KV
+# store (r3 weak #5: base64 pickle through rank-0's single-threaded
+# store is O(n) copies — fine for control-plane scalars, wrong for
+# tensors)
+_SOCKET_MIN_BYTES = 1 << 16
+
+_dataplane = [None]
+
+
+def get_dataplane():
+    """Per-process data-plane singleton (lazy listener)."""
+    if _dataplane[0] is None:
+        from .dataplane import DataPlane
+
+        _dataplane[0] = DataPlane()
+    return _dataplane[0]
+
+
 class StoreGroupComm:
     """One rank's view of a rank-subset group (ring analog: the
     reference registers one comm per ring_id; we key rounds by the
-    group tag)."""
+    group tag).
+
+    Transport split (gen_comm_id_helper.cc pattern): the KV store is
+    the RENDEZVOUS plane — barriers, round sequencing, small payloads,
+    and each rank's data-plane endpoint (`dp/{rank}`) — while tensor
+    bytes >= _SOCKET_MIN_BYTES move point-to-point over direct TCP
+    (dataplane.py)."""
 
     def __init__(self, ranks, my_rank, tag=None, store=None):
         self.ranks = [int(r) for r in sorted(ranks)]
@@ -122,6 +146,18 @@ class StoreGroupComm:
         self.tag = tag or "g" + "_".join(map(str, self.ranks))
         self._store = store or get_store()
         self._seq = 0
+        # publish this rank's data-plane endpoint so peers can stream
+        # tensors directly (senders look it up once and cache)
+        self._dp = get_dataplane()
+        self._store.put(f"dp/{self.rank}", self._dp.endpoint, ttl=0)
+        self._dp_peers = {}
+
+    def _peer_endpoint(self, r, timeout=60.0):
+        ep = self._dp_peers.get(r)
+        if ep is None:
+            ep = self._wait_get(f"dp/{int(r)}", timeout)
+            self._dp_peers[r] = ep
+        return ep
 
     # -- plumbing ----------------------------------------------------
     def _key(self, seq, who, kind="c"):
@@ -139,14 +175,30 @@ class StoreGroupComm:
             f"{self.ranks} — is every member calling the collective?")
 
     def _exchange(self, arr, timeout):
-        """Contribute my array, collect everyone's (by group order)."""
+        """Contribute my array, collect everyone's (by group order).
+        Large arrays move all-pairs over the data plane; the store
+        carries only the round's existence (sequencing is implicit in
+        the shared per-group _seq discipline)."""
+        arr = np.asarray(arr)
         seq = self._seq
         self._seq += 1
+        if arr.nbytes >= _SOCKET_MIN_BYTES:
+            tag = f"x/{self.tag}"
+            for r in self.ranks:
+                if r != self.rank:
+                    self._dp.send(self._peer_endpoint(r, timeout),
+                                  self.rank, tag, seq, arr)
+            out = []
+            for r in self.ranks:
+                out.append(arr if r == self.rank
+                           else self._dp.recv(r, tag, seq,
+                                              timeout=timeout))
+            return out
         self._store.put(self._key(seq, self.rank), _enc(arr), ttl=_TTL)
         out = []
         for r in self.ranks:
             if r == self.rank:
-                out.append(np.asarray(arr))
+                out.append(arr)
             else:
                 out.append(_dec(self._wait_get(self._key(seq, r),
                                                timeout)))
@@ -172,9 +224,19 @@ class StoreGroupComm:
     def broadcast(self, arr, src, timeout=180.0):
         seq = self._seq
         self._seq += 1
+        arr = np.asarray(arr)
+        if arr.nbytes >= _SOCKET_MIN_BYTES:
+            tag = f"b/{self.tag}"
+            if self.rank == int(src):
+                for r in self.ranks:
+                    if r != self.rank:
+                        self._dp.send(self._peer_endpoint(r, timeout),
+                                      self.rank, tag, seq, arr)
+                return arr
+            return self._dp.recv(int(src), tag, seq, timeout=timeout)
         if self.rank == int(src):
             self._store.put(self._key(seq, "b"), _enc(arr), ttl=_TTL)
-            return np.asarray(arr)
+            return arr
         return _dec(self._wait_get(self._key(seq, "b"), timeout))
 
     def barrier(self, timeout=180.0):
@@ -192,28 +254,45 @@ class StoreGroupComm:
                 self._wait_get(self._key(seq, r, kind="d"), timeout)
 
     def send(self, arr, dst, timeout=180.0):
-        """p2p: unlike the round-based collectives, p2p keys are
-        sequenced per (src, dst) EDGE so interleaved pairs don't
-        collide (send_v2/recv_v2 analog). The sequence counters are
-        LOCAL (sender/receiver each track their edge position) and the
-        data keys persist until the receiver consumes-and-deletes —
-        a TTL'd counter in the store would reset on long gaps and
-        silently lose or overwrite messages."""
+        """p2p over the data plane (send_v2/recv_v2 analog): sequenced
+        per (src, dst) EDGE so interleaved pairs don't collide; the
+        receiver's inbox reorders by seq. Sub-threshold scalars still
+        ride the store — with a FINITE generous TTL now (ADVICE r3:
+        ttl=0 p2p keys accumulated forever when a receiver died)."""
         if not hasattr(self, "_snd"):
             self._snd = {}
         k = f"p2p/{self.tag}/{self.rank}->{int(dst)}"
         n = self._snd.get(k, 0)
-        self._store.put(k + f"/{n}", _enc(arr), ttl=0)
         self._snd[k] = n + 1
+        arr = np.asarray(arr)
+        if arr.nbytes >= _SOCKET_MIN_BYTES:
+            self._dp.send(self._peer_endpoint(int(dst), timeout),
+                          self.rank, f"p/{self.tag}", n, arr)
+            return
+        self._store.put(k + f"/{n}", _enc(arr), ttl=3600.0)
 
     def recv(self, src, timeout=180.0):
         k = f"p2p/{self.tag}/{int(src)}->{self.rank}"
         if not hasattr(self, "_rcv"):
             self._rcv = {}
         n = self._rcv.get(k, 0)
-        val = _dec(self._wait_get(k + f"/{n}", timeout))
-        # advance + clean ONLY after a successful fetch: a timeout
-        # retried by the caller must wait on the same index, not skip
-        self._rcv[k] = n + 1
-        self._store.delete(k + f"/{n}")
-        return val
+        # the edge's transport is decided by the SENDER per message:
+        # poll both the store key and the data-plane inbox for seq n
+        deadline = time.time() + timeout
+        while True:
+            v = self._store.get(k + f"/{n}")
+            if v is not None:
+                self._rcv[k] = n + 1
+                self._store.delete(k + f"/{n}")
+                return _dec(v)
+            try:
+                val = self._dp.recv(int(src), f"p/{self.tag}", n,
+                                    timeout=_POLL * 4)
+                self._rcv[k] = n + 1
+                return val
+            except TimeoutError:
+                pass
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"p2p recv timeout: {k} seq {n} (store and "
+                    "data plane both empty)")

@@ -560,6 +560,24 @@ class TrainStepCompiler:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
+    def lower_compiled(self, *batch):
+        """Build + lower + compile the step WITHOUT executing it —
+        the auto-parallel planner reads `cost_analysis()` off the
+        result (per-device flops/bytes of the partitioned module)."""
+        trainable, frozen, bufs = self._params_and_buffers()
+        self._prepare_call(trainable, frozen, bufs)
+        if self._compiled is None:
+            self._build(trainable, frozen, bufs, batch)
+        pvals = {k: p._value for k, p in trainable.items()}
+        fvals = {k: p._value for k, p in frozen.items()}
+        bvals = {k: b._value for k, b in bufs.items()}
+        avals = self._place_batch(batch)
+        lr = np.float32(self._opt.get_lr())
+        rngc = np.uint32(self._step)
+        return self._compiled.lower(
+            pvals, self._opt_state, self._accum_state, fvals, bvals,
+            avals, lr, rngc).compile()
+
     def __call__(self, *batch):
         trainable, frozen, bufs = self._params_and_buffers()
         self._prepare_call(trainable, frozen, bufs)

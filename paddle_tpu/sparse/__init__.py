@@ -1,23 +1,73 @@
-"""paddle.sparse (reference: paddle/phi/core/sparse_coo_tensor.h,
-python/paddle/sparse). Round-1: COO/CSR containers + conversions +
-basic ops; TPU kernels operate on densified segments (XLA has no
-first-class sparse)."""
+"""paddle.sparse — COO/CSR tensors WITH kernels (r4; r3 shipped
+containers only).
+
+Reference surface: paddle/phi/core/sparse_coo_tensor.h,
+paddle/phi/kernels/sparse/ (the snapshot carries dense<->COO<->CSR
+conversion kernels; the grown library adds matmul / elementwise /
+unary / reduction — all provided here, scipy-referenced in
+tests/test_sparse.py).
+
+TPU-native design: XLA has no first-class sparse storage, and dynamic
+nnz is a dynamic shape — so the representation is STATIC-nnz
+coordinate storage and every kernel is a gather/scatter-add program
+(ops XLA schedules well on TPU):
+
+  * spmm:   out[rows] += vals * dense[cols]   (gather + segment-add)
+  * unary:  zero-preserving fns map over values only
+  * binary: pattern-union by concatenation (duplicates are LEGAL in
+    COO semantics — to_dense accumulates; `coalesce()` merges
+    eagerly, where data-dependent nnz is allowed)
+  * CSR kernels reuse the COO programs through a static-shape row
+    decompression (searchsorted over crows — nnz is static, so this
+    traces under jit)
+
+Gradients: kernels run through apply_op on the VALUES tensors, so the
+tape differentiates them like any dense op (gather/scatter-add have
+exact VJPs); indices are integer tensors with zero tangents.
+"""
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
+from ..core.engine import apply_op
 from ..core.tensor import Tensor, to_tensor
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor"]
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "matmul", "masked_matmul", "add", "subtract",
+    "multiply", "divide", "relu", "tanh", "sin", "sinh", "asin",
+    "asinh", "atan", "atanh", "sqrt", "square", "abs", "pow", "neg",
+    "cast", "scale", "sum", "transpose", "to_sparse_coo",
+    "is_same_shape",
+]
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    arr = np.asarray(x)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return to_tensor(arr)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 class SparseCooTensor:
+    """indices [sparse_ndim, nnz] int32 + values [nnz, *dense_dims].
+    Duplicate coordinates are allowed and accumulate (COO semantics);
+    coalesce() merges them eagerly."""
+
     def __init__(self, indices, values, shape):
         self.indices_t = indices
         self.values_t = values
-        self.dense_shape = list(shape)
+        self.dense_shape = [int(s) for s in shape]
 
+    # -- container API -------------------------------------------------
     def indices(self):
         return self.indices_t
 
@@ -28,49 +78,492 @@ class SparseCooTensor:
     def shape(self):
         return self.dense_shape
 
-    def to_dense(self):
-        idx = np.asarray(self.indices_t._value)
-        vals = self.values_t._value
-        out = jnp.zeros(tuple(self.dense_shape), vals.dtype)
-        out = out.at[tuple(idx)].add(vals)
-        return Tensor(out, _internal=True)
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    def nnz(self):
+        return int(self.indices_t.shape[1])
 
     def is_sparse(self):
         return True
 
+    def is_sparse_coo(self):
+        return True
 
-def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
-                      stop_gradient=True):
-    if not isinstance(indices, Tensor):
-        indices = to_tensor(np.asarray(indices))
-    if not isinstance(values, Tensor):
-        values = to_tensor(np.asarray(values))
-    if shape is None:
-        idx = np.asarray(indices._value)
-        shape = (idx.max(axis=1) + 1).tolist()
-    return SparseCooTensor(indices, values, shape)
+    def is_sparse_csr(self):
+        return False
+
+    def to_dense(self):
+        sparse_nd = int(self.indices_t.shape[0])
+        full_shape = tuple(self.dense_shape)
+
+        def _k(idx, vals):
+            out = jnp.zeros(full_shape, vals.dtype)
+            return out.at[tuple(idx[d] for d in range(sparse_nd))
+                          ].add(vals)
+
+        return apply_op("sparse_coo_to_dense", _k, self.indices_t,
+                        self.values_t)
+
+    def coalesce(self):
+        """Merge duplicate coordinates (eager only: the merged nnz is
+        data-dependent, which XLA's static shapes cannot express —
+        the same boundary the reference's Coalesce kernel draws)."""
+        idx = np.asarray(self.indices_t._value)
+        vals = np.asarray(self.values_t._value)
+        keys = np.ravel_multi_index(
+            tuple(idx), tuple(self.dense_shape[:idx.shape[0]]))
+        uniq, inv = np.unique(keys, return_inverse=True)
+        merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(merged, inv, vals)
+        new_idx = np.stack(np.unravel_index(
+            uniq, tuple(self.dense_shape[:idx.shape[0]])))
+        return SparseCooTensor(
+            to_tensor(new_idx.astype(np.int32)), to_tensor(merged),
+            self.dense_shape)
+
+    def to_sparse_csr(self):
+        if len(self.dense_shape) != 2:
+            raise ValueError("to_sparse_csr: 2-D only")
+        c = self.coalesce()
+        idx = np.asarray(c.indices_t._value)
+        nrows = self.dense_shape[0]
+        crows = np.zeros(nrows + 1, np.int32)
+        np.add.at(crows, idx[0] + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(to_tensor(crows),
+                               to_tensor(idx[1].astype(np.int32)),
+                               c.values_t, self.dense_shape)
+
+    def astype(self, dtype):
+        return cast(self, value_dtype=dtype)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.dense_shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+    # operator sugar
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
 
 
 class SparseCsrTensor:
+    """crows [nrows+1], cols [nnz], values [nnz] — 2-D CSR."""
+
     def __init__(self, crows, cols, values, shape):
         self.crows_t = crows
         self.cols_t = cols
         self.values_t = values
-        self.dense_shape = list(shape)
+        self.dense_shape = [int(s) for s in shape]
+
+    def crows(self):
+        return self.crows_t
+
+    def cols(self):
+        return self.cols_t
+
+    def values(self):
+        return self.values_t
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    def nnz(self):
+        return int(self.cols_t.shape[0])
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _rows(self):
+        """Static-shape row decompression: rows[i] = the row whose
+        [crows[r], crows[r+1]) range contains i. searchsorted keeps
+        the [nnz] output shape static, so this traces under jit
+        (np.repeat over diff(crows) would not)."""
+        nnz = int(self.cols_t.shape[0])
+
+        def _k(crows):
+            pos = jnp.arange(nnz, dtype=jnp.int32)
+            return (jnp.searchsorted(crows, pos, side="right") - 1
+                    ).astype(jnp.int32)
+
+        return apply_op("csr_rows", _k, self.crows_t)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = self._rows()
+
+        def _k(rows, cols):
+            return jnp.stack([rows, cols.astype(jnp.int32)])
+
+        idx = apply_op("csr_to_coo_indices", _k, rows, self.cols_t)
+        return SparseCooTensor(idx, self.values_t, self.dense_shape)
 
     def to_dense(self):
-        crows = np.asarray(self.crows_t._value)
-        cols = np.asarray(self.cols_t._value)
-        vals = self.values_t._value
-        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-        out = jnp.zeros(tuple(self.dense_shape), vals.dtype)
-        out = out.at[rows, cols].add(vals)
-        return Tensor(out, _internal=True)
+        return self.to_sparse_coo().to_dense()
+
+    def astype(self, dtype):
+        return cast(self, value_dtype=dtype)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.dense_shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
 
 
-def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
-                      stop_gradient=True):
-    def conv(x):
-        return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    indices = _as_tensor(indices, np.int32)
+    values = _as_tensor(values, dtype)
+    if shape is None:
+        idx = np.asarray(indices._value)
+        shape = (idx.max(axis=1) + 1).tolist()
+        shape = shape + list(values.shape[1:])
+    return SparseCooTensor(indices, values, shape)
 
-    return SparseCsrTensor(conv(crows), conv(cols), conv(values), shape)
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    return SparseCsrTensor(_as_tensor(crows, np.int32),
+                           _as_tensor(cols, np.int32),
+                           _as_tensor(values, dtype), shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> COO (eager: nnz is data-dependent — the
+    reference's DenseToSparseCooKernel draws the same boundary)."""
+    arr = np.asarray(_val(x))
+    nd = sparse_dim or arr.ndim
+    flat = arr.reshape(arr.shape[:nd] + (-1,))
+    mask = np.any(flat != 0, axis=-1)
+    idx = np.stack(np.nonzero(mask)).astype(np.int32)
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(to_tensor(idx), to_tensor(vals),
+                           list(arr.shape))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+def _coo_of(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def matmul(x, y, name=None):
+    """sparse [M,K] @ dense [K,N] -> dense [M,N] (COO or CSR lhs).
+    The kernel is gather(rows of y at cols) * vals -> scatter-add into
+    out rows: both primitives carry exact VJPs, so d(out)/d(values)
+    and d(out)/d(y) flow through the tape like any dense op."""
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("sparse.matmul: lhs must be sparse")
+    if len(x.shape) != 2:
+        raise ValueError("sparse.matmul: 2-D lhs only")
+    xc = _coo_of(x)
+    m = x.shape[0]
+
+    def _k(idx, vals, dense):
+        rows, cols = idx[0], idx[1]
+        contrib = vals[:, None] * dense[cols]        # [nnz, N]
+        out = jnp.zeros((m, dense.shape[1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+
+    return apply_op("sparse_matmul", _k, xc.indices_t, xc.values_t,
+                    y if isinstance(y, Tensor) else _as_tensor(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense [M,K] @ dense [K,N], sampled at `mask`'s sparsity pattern
+    (SDDMM). Returns a sparse tensor carrying mask's indices."""
+    mc = _coo_of(mask)
+
+    def _k(idx, a, b):
+        rows, cols = idx[0], idx[1]
+        return jnp.einsum("nk,nk->n", a[rows], b.T[cols])
+
+    vals = apply_op("sparse_sddmm", _k, mc.indices_t,
+                    x if isinstance(x, Tensor) else _as_tensor(x),
+                    y if isinstance(y, Tensor) else _as_tensor(y))
+    return SparseCooTensor(mc.indices_t, vals, mask.shape)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+
+def _binary_union(x, y, sign):
+    """sp +/- sp by pattern union: concatenate coordinates — COO
+    permits duplicates (to_dense accumulates), so this is exact with
+    STATIC output nnz = nnz_x + nnz_y. coalesce() afterwards if a
+    merged pattern is wanted."""
+    if list(x.shape) != list(y.shape):
+        raise ValueError("sparse add/subtract: shape mismatch")
+    xc, yc = _coo_of(x), _coo_of(y)
+
+    def _kidx(ix, iy):
+        return jnp.concatenate([ix, iy], axis=1)
+
+    def _kval(vx, vy):
+        return jnp.concatenate([vx, sign * vy], axis=0)
+
+    idx = apply_op("sparse_union_idx", _kidx, xc.indices_t,
+                   yc.indices_t)
+    vals = apply_op("sparse_union_val", _kval, xc.values_t,
+                    yc.values_t)
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+def add(x, y, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and \
+            isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        out = _binary_union(x, y, +1)
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+            else out
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        x, y = y, x  # dense + sparse commutes
+    xc = _coo_of(x)
+    sparse_nd = int(xc.indices_t.shape[0])
+
+    def _k(idx, vals, dense):
+        return dense.at[tuple(idx[d] for d in range(sparse_nd))
+                        ].add(vals)
+
+    return apply_op("sparse_add_dense", _k, xc.indices_t, xc.values_t,
+                    y if isinstance(y, Tensor) else _as_tensor(y))
+
+
+def subtract(x, y, name=None):
+    out = _binary_union(x, y, -1)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+        else out
+
+
+def multiply(x, y, name=None):
+    """sp * dense / sp * scalar -> sparse with x's pattern (values
+    scaled by the dense entries at the coordinates)."""
+    if isinstance(y, (int, float)):
+        return scale(x, float(y))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # same-shape product: zeros anywhere kill the entry, so
+        # multiplying by the other side's dense form is exact
+        y = y.to_dense()
+    xc = _coo_of(x)
+    sparse_nd = int(xc.indices_t.shape[0])
+
+    def _k(idx, vals, dense):
+        return vals * dense[tuple(idx[d] for d in range(sparse_nd))]
+
+    vals = apply_op("sparse_mul_dense", _k, xc.indices_t, xc.values_t,
+                    y if isinstance(y, Tensor) else _as_tensor(y))
+    out = SparseCooTensor(xc.indices_t, vals, x.shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+        else out
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return scale(x, 1.0 / float(y))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = y.to_dense()
+    xc = _coo_of(x)
+    sparse_nd = int(xc.indices_t.shape[0])
+
+    def _k(idx, vals, dense):
+        return vals / dense[tuple(idx[d] for d in range(sparse_nd))]
+
+    vals = apply_op("sparse_div_dense", _k, xc.indices_t, xc.values_t,
+                    y if isinstance(y, Tensor) else _as_tensor(y))
+    out = SparseCooTensor(xc.indices_t, vals, x.shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+        else out
+
+
+# ---------------------------------------------------------------------------
+# zero-preserving unary ops — map over values, pattern unchanged
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn, x):
+    def _k(vals):
+        return fn(vals)
+
+    vals = apply_op(f"sparse_{name}", _k, x.values_t)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows_t, x.cols_t, vals, x.shape)
+    return SparseCooTensor(x.indices_t, vals, x.shape)
+
+
+def relu(x, name=None):
+    return _unary("relu", lambda v: jnp.maximum(v, 0), x)
+
+
+def tanh(x, name=None):
+    return _unary("tanh", jnp.tanh, x)
+
+
+def sin(x, name=None):
+    return _unary("sin", jnp.sin, x)
+
+
+def sinh(x, name=None):
+    return _unary("sinh", jnp.sinh, x)
+
+
+def asin(x, name=None):
+    return _unary("asin", jnp.arcsin, x)
+
+
+def asinh(x, name=None):
+    return _unary("asinh", jnp.arcsinh, x)
+
+
+def atan(x, name=None):
+    return _unary("atan", jnp.arctan, x)
+
+
+def atanh(x, name=None):
+    return _unary("atanh", jnp.arctanh, x)
+
+
+def sqrt(x, name=None):
+    return _unary("sqrt", jnp.sqrt, x)
+
+
+def square(x, name=None):
+    return _unary("square", jnp.square, x)
+
+
+def abs(x, name=None):  # noqa: A001 - reference name
+    return _unary("abs", jnp.abs, x)
+
+
+def neg(x, name=None):
+    return _unary("neg", jnp.negative, x)
+
+
+def pow(x, factor, name=None):  # noqa: A001 - reference name
+    return _unary("pow", lambda v: jnp.power(v, factor), x)
+
+
+def scale(x, scale_v, bias=0.0, bias_after_scale=True, name=None):
+    if bias != 0.0:
+        raise ValueError(
+            "sparse.scale with bias != 0 densifies (the bias lands on "
+            "every zero) — add the bias to to_dense() instead")
+    return _unary("scale", lambda v: v * scale_v, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    def _k(vals):
+        return vals.astype(value_dtype) if value_dtype else vals
+
+    vals = apply_op("sparse_cast", _k, x.values_t)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows_t, x.cols_t, vals, x.shape)
+    idx = x.indices_t
+    if index_dtype is not None:
+        idx = to_tensor(np.asarray(idx._value).astype(index_dtype))
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# reduction / layout
+# ---------------------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reduce to a DENSE tensor (sum over all, or segment-sum over an
+    axis). Hybrid COO (sparse_ndim < tensor rank): sparse axes reduce
+    by segment-sum, dense (trailing) axes by reducing the values."""
+    xc = _coo_of(x)
+    nd = len(x.shape)
+    sparse_nd = int(xc.indices_t.shape[0])
+
+    if axis is None:
+        def _k(vals):
+            out = jnp.sum(vals)
+            return out.astype(dtype) if dtype else out
+
+        return apply_op("sparse_sum_all", _k, xc.values_t)
+    ax = axis if axis >= 0 else axis + nd
+    out_shape = tuple(s for i, s in enumerate(x.shape) if i != ax)
+
+    def _k(idx, vals):
+        if ax >= sparse_nd:
+            # dense-dim reduction: values axis (ax - sparse_nd) + 1
+            red = jnp.sum(vals, axis=ax - sparse_nd + 1)
+            out = jnp.zeros(out_shape, red.dtype)
+            out = out.at[tuple(idx[d] for d in range(sparse_nd))
+                         ].add(red)
+        else:
+            keep = [idx[d] for d in range(sparse_nd) if d != ax]
+            if keep:
+                out = jnp.zeros(out_shape, vals.dtype)
+                out = out.at[tuple(keep)].add(vals)
+            else:
+                # the only sparse axis reduced: nothing left to
+                # scatter by — the result is the plain value sum
+                out = jnp.sum(vals, axis=0)
+        if dtype:
+            out = out.astype(dtype)
+        if keepdim:
+            return jnp.expand_dims(out, ax)
+        return out
+
+    return apply_op("sparse_sum_axis", _k, xc.indices_t, xc.values_t)
+
+
+def transpose(x, perm=None, name=None):
+    xc = _coo_of(x)
+    nd = len(x.shape)
+    sparse_nd = int(xc.indices_t.shape[0])
+    perm = list(perm) if perm is not None else list(range(nd))[::-1]
+    if sparse_nd < nd and sorted(perm[:sparse_nd]) != list(
+            range(sparse_nd)):
+        raise NotImplementedError(
+            "sparse.transpose on a hybrid COO tensor may only permute "
+            "within the sparse dims (values carry the dense dims)")
+
+    def _k(idx):
+        return jnp.stack([idx[p] for p in perm[:sparse_nd]])
+
+    idx = apply_op("sparse_transpose_idx", _k, xc.indices_t)
+    vals = xc.values_t
+    if sparse_nd < nd:
+        dense_perm = [p - sparse_nd + 1 for p in perm[sparse_nd:]]
+        if dense_perm != list(range(1, nd - sparse_nd + 1)):
+            def _kv(v):
+                return jnp.transpose(v, [0] + dense_perm)
+
+            vals = apply_op("sparse_transpose_vals", _kv, vals)
+    out = SparseCooTensor(idx, vals, [x.shape[p] for p in perm])
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+        else out

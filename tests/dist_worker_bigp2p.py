@@ -1,0 +1,64 @@
+"""Worker for the socket data-plane p2p test (reference
+gen_comm_id_helper.cc split: store = rendezvous, sockets = data).
+
+2 ranks: rank 0 sends a >=64 MB tensor to rank 1 (send_v2/recv_v2
+analog), then a large subgroup broadcast runs the other way. Each rank
+records wall times and data-plane counters as JSON so the parent can
+assert the socket path (not the KV store) carried the bytes, within a
+time bound.
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import store_collective  # noqa: E402
+from paddle_tpu.distributed.mesh import new_group_for_axes  # noqa: E402
+
+
+def main(out_prefix):
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out = {}
+    mb = 64
+    n = mb * (1 << 20) // 4  # 64 MB of float32
+    g = new_group_for_axes((), ranks=[0, 1])
+
+    t0 = time.perf_counter()
+    if rank == 0:
+        big = np.arange(n, dtype=np.float32)
+        dist.send(paddle.to_tensor(big), dst=1)
+        out["send_s"] = time.perf_counter() - t0
+    else:
+        got = dist.recv(paddle.to_tensor(np.zeros(n, np.float32)),
+                        src=0)
+        out["recv_s"] = time.perf_counter() - t0
+        arr = np.asarray(got.numpy()).ravel()
+        out["ok_first_last"] = [float(arr[0]), float(arr[-1])]
+        out["nbytes"] = int(arr.nbytes)
+
+    # large broadcast 1 -> 0 through the same group (collective path)
+    t1 = time.perf_counter()
+    val = (np.full(n // 4, float(rank + 1), np.float32))
+    b = dist.broadcast(paddle.to_tensor(val), src=1, group=g)
+    out["bcast_s"] = time.perf_counter() - t1
+    out["bcast_val"] = float(np.asarray(b.numpy()).ravel()[0])
+
+    dp = store_collective.get_dataplane()
+    out["dp_sends"] = dp.sends
+    out["dp_recvs"] = dp.recvs
+    with open(f"{out_prefix}.rank{rank}", "w") as f:
+        json.dump(out, f)
+    # barrier so rank 0 (store host) outlives rank 1's reads
+    dist.barrier()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -164,3 +164,56 @@ def test_eager_subgroup_collectives_and_p2p(tmp_path):
         assert r["gather"] == [0.0, 20.0]
     assert r1["bystander"] is True
     assert r1["recv"] == [7.0, 8.0]    # in-order p2p
+
+
+def test_big_tensor_p2p_over_sockets(tmp_path):
+    """VERDICT r4 #7 'done' criterion: a >=64 MB tensor ships p2p
+    within a time bound, over the DIRECT SOCKET data plane (the KV
+    store carries only rendezvous). 2 processes; counters prove the
+    socket path moved the bytes."""
+    worker = os.path.join(REPO, "tests", "dist_worker_bigp2p.py")
+    port = _free_port()
+    nprocs = 2
+    endpoints = ",".join(f"127.0.0.1:{port + i}" for i in range(nprocs))
+    out_prefix = str(tmp_path / "bigp2p")
+    store_port = _free_port()
+    procs = []
+    for rank in range(nprocs):
+        env = _clean_env()
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_STORE_PORT": str(store_port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, out_prefix], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=180)[0]
+                        .decode(errors="replace"))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            outs.append(p.communicate()[0].decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    r0 = json.load(open(f"{out_prefix}.rank0"))
+    r1 = json.load(open(f"{out_prefix}.rank1"))
+    assert r1["nbytes"] == 64 * (1 << 20)
+    assert r1["ok_first_last"] == [0.0, float(64 * (1 << 20) // 4 - 1)]
+    # time bound: localhost sockets move 64 MB in well under 30 s even
+    # on a loaded CI box (the old base64-through-store path measured
+    # minutes at this size)
+    assert r1["recv_s"] < 30.0, r1
+    assert r0["send_s"] < 30.0, r0
+    assert r0["bcast_val"] == 2.0 and r1["bcast_val"] == 2.0
+    # the SOCKET path carried the payloads
+    assert r0["dp_sends"] >= 1, r0
+    assert r1["dp_recvs"] >= 1, r1
+    assert r1["dp_sends"] >= 1, r1  # broadcast 1 -> 0
