@@ -187,3 +187,73 @@ def _beam_search(step_fn, init_state, start_token, end_token, K,
     seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return seqs, scores
+
+
+def _k_beam_search_step(pre_ids, pre_scores, ids, scores, beam_size,
+                        end_id, is_accumulated):
+    nb = pre_scores.shape[0] // beam_size  # batch groups
+    V = scores.shape[-1]
+    ps = pre_scores.reshape(nb, beam_size)
+    if is_accumulated:
+        acc = scores.reshape(nb, beam_size, V)
+    else:
+        # raw probabilities: accumulate in log space on top of the
+        # parent beam score (beam_search_op.cc is_accumulated=false)
+        acc = ps[:, :, None] + jnp.log(
+            jnp.maximum(scores.reshape(nb, beam_size, V), 1e-20))
+    # candidate -> vocab-id mapping: positional (scores index the
+    # vocab) or via the `ids` input (the topk -> beam_search
+    # composition, where column j of scores is candidate ids[., j])
+    if ids is None:
+        vocab = jnp.broadcast_to(
+            jnp.arange(V, dtype=pre_ids.dtype)[None, None, :],
+            (nb, beam_size, V))
+    else:
+        vocab = ids.reshape(nb, beam_size, V).astype(pre_ids.dtype)
+    # finished beams (pre_ids == end_id) emit ONLY end_id, keeping
+    # their score — the reference's finished-lane handling. The end
+    # candidate is wherever vocab == end_id in that lane (positional:
+    # column end_id; via ids: any column carrying end_id).
+    finished = (pre_ids.reshape(nb, beam_size) == end_id)
+    is_end = (vocab == end_id)
+    only_end = jnp.where(is_end, ps[:, :, None], -1e9)
+    acc = jnp.where(finished[:, :, None], only_end, acc)
+    flat = acc.reshape(nb, beam_size * V)
+    top_scores, top_pos = jax.lax.top_k(flat, beam_size)
+    parent_in_group = top_pos // V                       # [nb, beam]
+    token = jnp.take_along_axis(
+        vocab.reshape(nb, beam_size * V), top_pos, axis=1)
+    parent_idx = (parent_in_group
+                  + jnp.arange(nb, dtype=parent_in_group.dtype)[:, None]
+                  * beam_size)
+    return (token.reshape(-1, 1), top_scores.reshape(-1, 1),
+            parent_idx.reshape(-1))
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, return_parent_idx=True,
+                name=None):
+    """ONE beam-search step — the raw op API (beam_search_op.cc; the
+    layer-level BeamSearchDecoder in nn/layer/decode.py composes
+    whole decodes). Inputs follow the reference's flattened layout:
+    pre_ids/pre_scores [batch*beam, 1], scores [batch*beam, V]
+    (accumulated log-probs when is_accumulated, else raw probs); `ids`
+    is None when scores index the vocab directly, or the candidate
+    vocab ids [batch*beam, K] from the reference's topk ->
+    beam_search composition (selected tokens gather THROUGH ids).
+    Returns (selected_ids [batch*beam, 1], selected_scores
+    [batch*beam, 1], parent_idx [batch*beam]) — parent_idx are GLOBAL
+    row indices for gathering the surviving lanes.
+    """
+    del level  # LoD level is implicit in the flattened layout
+    out = apply_op("beam_search", _k_beam_search_step, pre_ids,
+                   pre_scores, ids, scores, beam_size=int(beam_size),
+                   end_id=int(end_id),
+                   is_accumulated=bool(is_accumulated))
+    sel_ids, sel_scores, parent = out
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+__all__.append("beam_search")

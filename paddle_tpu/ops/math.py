@@ -495,3 +495,30 @@ _export("nanquantile", nanquantile)
 _export("numel", numel)
 _export("broadcast_shape", broadcast_shape)
 _export("diff", diff)
+
+
+def _k_renorm(x, p, axis, max_norm):
+    ax = axis % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    if p == float("inf"):
+        norms = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    else:
+        norms = jnp.sum(jnp.abs(x) ** p, axis=red,
+                        keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm,
+                       max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * factor.astype(x.dtype)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale each sub-tensor along `axis` whose p-norm exceeds
+    max_norm down to exactly max_norm (renorm_op.cc:64 — "scale tensor
+    sliced by axis if its p-norm exceeds maxnorm"); sub-tensors within
+    the bound pass through unchanged."""
+    if p <= 0:
+        raise ValueError("renorm: p must be positive")
+    return apply_op("renorm", _k_renorm, x, p=float(p), axis=int(axis),
+                    max_norm=float(max_norm))
+
+
+_export("renorm", renorm)

@@ -1,0 +1,260 @@
+"""Static-graph quantization passes — Program-rewrite QAT / PTQ.
+
+Parity target: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass inserts fake_quantize/
+dequantize ops around conv/mul/matmul in the Program;
+QuantizationFreezePass converts weights to int8; ~15 files) and
+post_training_quantization.py (calibration-driven scales).
+
+TPU-native design over the recorded IR (static/graph.py): a Program op
+is an OpRecord carrying its jax kernel, so "inserting fake-quant ops
+around X" is a KERNEL REWRITE — the pass wraps the recorded kernel of
+every quantizable op with weight/activation fake-quant, and XLA fuses
+the quant arithmetic into the surrounding matmul exactly as the
+reference's inserted ops fuse at runtime. Three pieces:
+
+  * QuantizationTransformPass — QAT rewrite: per-output-channel
+    abs-max weight fake-quant + per-batch (dynamic abs_max)
+    activation fake-quant, straight-through estimator; the rewritten
+    Program TRAINS (append_backward differentiates the wrapped
+    kernel).
+  * calibrate_program — PTQ step 1: eager replay over calibration
+    feeds recording each quantizable op's activation abs-max.
+  * QuantizationFreezePass — PTQ step 2: weights convert to STORED
+    int8 leaves + fp scales (weight-only int8, the TPU serving
+    pattern); activations quantize with the calibrated static scales.
+
+Quantizable op types and their (activation, weight) argument
+positions / weight channel axes mirror the kernels in ops/ and
+nn/functional (linear/matmul: W [in, out] -> channel axis -1;
+conv*: OIHW -> axis 0).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..static.passes import Pass, register_pass
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "calibrate_program", "quant_post_static"]
+
+# op type -> (activation arg idx, weight arg idx, weight channel axis)
+_QUANTIZABLE = {
+    "linear": (0, 1, -1),
+    "matmul": (0, 1, -1),
+    "mul": (0, 1, -1),
+    "conv1d": (0, 1, 0),
+    "conv2d": (0, 1, 0),
+    "conv3d": (0, 1, 0),
+}
+
+
+# ONE fake-quant/scale implementation for the whole package: the
+# dygraph QAT path (quantization/__init__.py) owns it; the static
+# passes import it so the STE/clip/epsilon semantics cannot diverge
+from . import _abs_max_per_channel, _k_fake_quant
+
+
+def _fq(x, scale, bits):
+    return _k_fake_quant(x, scale, bits)
+
+
+def _per_channel_scale(w, axis):
+    return _abs_max_per_channel(w, axis % w.ndim)
+
+
+@register_pass("quantization_transform_pass")
+class QuantizationTransformPass(Pass):
+    """QAT rewrite (QuantizationTransformPass analog)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=None):
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.types = dict(_QUANTIZABLE)
+        if quantizable_op_type is not None:
+            self.types = {t: _QUANTIZABLE[t]
+                          for t in quantizable_op_type}
+        self.rewritten = 0
+
+    def _wrap(self, fn, spec):
+        a_idx, w_idx, ch_axis = spec
+        wbits, abits = self.wbits, self.abits
+
+        def qfn(*args, **kwargs):
+            args = list(args)
+            a, w = args[a_idx], args[w_idx]
+            args[a_idx] = _fq(a, jnp.max(jnp.abs(a)), abits)
+            args[w_idx] = _fq(w, _per_channel_scale(w, ch_axis), wbits)
+            return fn(*args, **kwargs)
+
+        qfn.__wrapped_quant__ = fn
+        return qfn
+
+    def apply(self, program):
+        for blk in program.blocks:
+            for op in blk.ops:
+                if op.type in self.types and not hasattr(
+                        op.fn, "__wrapped_quant__"):
+                    op.fn = self._wrap(op.fn, self.types[op.type])
+                    self.rewritten += 1
+        # compiled-replay caches key on the version — the rewrite must
+        # not serve stale executables
+        program._version = getattr(program, "_version", 0) + 1
+        return program
+
+
+def _eager_replay(program, feed):
+    """Replay the Program OUTSIDE jit (kernels execute eagerly) so
+    host-side observers can read intermediate values — the reference's
+    sampling-executor calibration run. Ops whose inputs are
+    unresolvable (they depend on feeds the calibration set omits,
+    e.g. labels) are SKIPPED, matching the reference's
+    fetch-pruned sampling program."""
+    from ..static.graph import replay_block
+
+    env = {}
+    for n, var in getattr(program, "_feeds", {}).items():
+        if n in feed:
+            env[id(var)] = jnp.asarray(np.asarray(feed[n]))
+    for p in program.all_parameters():
+        env[id(p)] = p._value
+    replay_block(program.global_block(), env, skip_unresolvable=True)
+    return env
+
+
+def calibrate_program(program, feed_batches, fetch_list=None,
+                      quantizable_op_type=None):
+    """PTQ calibration: replay the Program EAGERLY over the feed
+    batches, observing each quantizable op's input-activation abs-max
+    (the reference runs a sampling executor collecting the same).
+    Returns {(block_idx, op_idx): activation_scale}."""
+    types = (dict(_QUANTIZABLE) if quantizable_op_type is None
+             else {t: _QUANTIZABLE[t] for t in quantizable_op_type})
+    scales: dict = {}
+    originals = {}
+    for bi, blk in enumerate(program.blocks):
+        for oi, op in enumerate(blk.ops):
+            if op.type not in types:
+                continue
+            key = (bi, oi)
+            a_idx = types[op.type][0]
+            originals[key] = op.fn
+
+            def observer(*args, _fn=op.fn, _key=key, _ai=a_idx,
+                         **kwargs):
+                a = np.asarray(args[_ai])
+                m = float(np.max(np.abs(a))) if a.size else 0.0
+                scales[_key] = max(scales.get(_key, 0.0), m)
+                return _fn(*args, **kwargs)
+
+            op.fn = observer
+    try:
+        for feed in feed_batches:
+            _eager_replay(program, feed)
+    finally:
+        for (bi, oi), fn in originals.items():
+            program.blocks[bi].ops[oi].fn = fn
+    return scales
+
+
+@register_pass("quantization_freeze_pass")
+class QuantizationFreezePass(Pass):
+    """PTQ freeze (QuantizationFreezePass analog): weight leaves
+    become STORED int8 + per-channel scales (dequantized in-kernel);
+    activations quantize with the calibrated scales."""
+
+    def __init__(self, scales=None, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=None):
+        self.scales = scales or {}
+        self.wbits = weight_bits
+        self.abits = activation_bits
+        self.types = (dict(_QUANTIZABLE) if quantizable_op_type is None
+                      else {t: _QUANTIZABLE[t]
+                            for t in quantizable_op_type})
+        self.frozen = 0
+        # weight leaves may be SHARED across ops (tied embeddings):
+        # the first op quantizes and records the scale; subsequent ops
+        # REUSE it — re-deriving a scale from already-int8 values
+        # would dequantize ~qmax x too large
+        self._frozen_leaves: dict = {}
+
+    def _freeze_weight(self, w_leaf, ch_axis):
+        w = np.asarray(w_leaf._value, np.float32)
+        qmax = float(2 ** (self.wbits - 1) - 1)
+        axis = ch_axis % w.ndim
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        scale = np.maximum(np.max(np.abs(w), axis=red, keepdims=True),
+                           1e-8) / qmax
+        q = np.clip(np.round(w / scale), -qmax - 1, qmax).astype(np.int8)
+        return q, scale.astype(np.float32)
+
+    def apply(self, program):
+        for bi, blk in enumerate(program.blocks):
+            for oi, op in enumerate(blk.ops):
+                if op.type not in self.types or hasattr(
+                        op.fn, "__frozen_quant__"):
+                    continue
+                a_idx, w_idx, ch_axis = self.types[op.type]
+                # locate the weight leaf: the w_idx-th leaf of the
+                # recorded input tree (kernels take leaves
+                # positionally). Only CONCRETE parameter leaves
+                # freeze — a Variable there means the "weight" is a
+                # computed intermediate (e.g. matmul of two
+                # activations), which has no storable int8 form.
+                from ..static.graph import Variable
+
+                w_leaf = (op.in_leaves[w_idx]
+                          if w_idx < len(op.in_leaves) else None)
+                if (not isinstance(w_leaf, Tensor)
+                        or isinstance(w_leaf, Variable)
+                        or len(w_leaf.shape) < 2):
+                    continue
+                if id(w_leaf) in self._frozen_leaves:
+                    scale = self._frozen_leaves[id(w_leaf)]
+                else:
+                    q, scale = self._freeze_weight(w_leaf, ch_axis)
+                    # store int8 IN PLACE: the Program's parameter
+                    # leaf now holds int8 (save_inference_model
+                    # serializes it)
+                    w_leaf._value = jnp.asarray(q)
+                    w_leaf.stop_gradient = True
+                    self._frozen_leaves[id(w_leaf)] = scale
+                act_scale = self.scales.get((bi, oi))
+                abits = self.abits
+                fn = op.fn
+
+                def qfn(*args, _fn=fn, _ai=a_idx, _wi=w_idx,
+                        _scale=jnp.asarray(scale), _as=act_scale,
+                        **kwargs):
+                    args = list(args)
+                    if _as:  # calibrated static activation quant
+                        args[_ai] = _fq(args[_ai], jnp.asarray(_as),
+                                        abits)
+                    args[_wi] = args[_wi].astype(jnp.float32) * _scale
+                    return _fn(*args, **kwargs)
+
+                qfn.__frozen_quant__ = fn
+                op.fn = qfn
+                self.frozen += 1
+        program._version = getattr(program, "_version", 0) + 1
+        return program
+
+
+def quant_post_static(program, feed_batches, fetch_list=None,
+                      weight_bits=8, activation_bits=8,
+                      quantizable_op_type=None):
+    """One-call PTQ (reference quant_post_static): calibrate, then
+    freeze. Returns the (in-place rewritten) program and the pass for
+    inspection."""
+    scales = calibrate_program(program, feed_batches,
+                               fetch_list=fetch_list,
+                               quantizable_op_type=quantizable_op_type)
+    p = QuantizationFreezePass(scales, weight_bits=weight_bits,
+                               activation_bits=activation_bits,
+                               quantizable_op_type=quantizable_op_type)
+    p.apply(program)
+    return program, p

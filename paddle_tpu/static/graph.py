@@ -270,10 +270,17 @@ def resolve_leaf(leaf, env):
     return leaf
 
 
-def replay_block(block, env):
-    """Execute a block's records in order; env: id(var) -> value."""
+def replay_block(block, env, skip_unresolvable=False):
+    """Execute a block's records in order; env: id(var) -> value.
+    skip_unresolvable: prune ops whose inputs have no value (used by
+    quantization calibration, which replays with partial feeds)."""
     for op in block.ops:
-        vals = [resolve_leaf(x, env) for x in op.in_leaves]
+        try:
+            vals = [resolve_leaf(x, env) for x in op.in_leaves]
+        except KeyError:
+            if skip_unresolvable:
+                continue
+            raise
         uargs = tree_util.tree_unflatten(op.in_treedef, vals)
         out = op.fn(*uargs, **op.kwargs)
         out_flat, _ = tree_util.tree_flatten(out)

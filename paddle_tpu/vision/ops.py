@@ -753,3 +753,95 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         restore[idx] = np.arange(pos, pos + len(idx))
         pos += len(idx)
     return outs, to_tensor(restore), nums
+
+
+# ---------------------------------------------------------------------------
+# matrix_nms (SOLOv2 decay NMS)
+# ---------------------------------------------------------------------------
+
+def _k_matrix_nms(bboxes, scores, score_threshold, post_threshold,
+                  nms_top_k, keep_top_k, use_gaussian, gaussian_sigma,
+                  background_label, normalized):
+    """One batch of Matrix NMS (matrix_nms_op.cc:81-150): instead of
+    hard suppression, every candidate's score DECAYS by the minimum
+    over higher-scored same-class boxes i of f(iou_ij)/f(max_iou_i) —
+    linear (1-iou)/(1-max_iou) or gaussian
+    exp((max_iou^2 - iou^2) * sigma).
+
+    Static-shape formulation: per class, candidates sort by score
+    (nms_top_k cap), the full IoU matrix is built once, max_iou is a
+    prefix max, and decays reduce with a masked min — no data-
+    dependent loops. Output is PADDED to keep_top_k rows per image
+    ([-1, 0, 0, 0, 0, 0] padding) + the true count.
+    """
+    N, C, M = scores.shape
+    k = min(int(nms_top_k), M) if nms_top_k > 0 else M
+    sigma = jnp.float32(gaussian_sigma)
+
+    def per_class(boxes, sc):
+        # boxes [M, 4], sc [M] (one class, one image)
+        order = jnp.argsort(-sc)[:k]
+        s = sc[order]
+        b = boxes[order]
+        iou = _k_iou_similarity(b, b, normalized)     # [k, k]
+        tri = jnp.tril(iou, -1)                       # j row, i<j cols
+        max_iou = jnp.max(tri, axis=1)                # max_iou[i]
+        if use_gaussian:
+            decay = jnp.exp((max_iou[None, :] ** 2 - tri ** 2) * sigma)
+        else:
+            decay = (1.0 - tri) / jnp.maximum(1.0 - max_iou[None, :],
+                                              1e-10)
+        # only i < j count; elsewhere decay 1
+        mask = jnp.tril(jnp.ones((k, k), bool), -1)
+        decay = jnp.where(mask, decay, 1.0)
+        dmin = jnp.min(decay, axis=1)
+        valid = s > score_threshold
+        return jnp.where(valid, s * dmin, -1.0), b
+
+    def per_image(boxes, sc):
+        # sc [C, M]; skip background
+        cls_ids = jnp.arange(C)
+        dec, bxs = jax.vmap(lambda c_sc: per_class(boxes, c_sc))(sc)
+        # dec [C, k], bxs [C, k, 4]
+        if background_label >= 0:
+            dec = dec.at[background_label].set(-1.0)
+        flat = dec.reshape(-1)
+        fbox = bxs.reshape(-1, 4)
+        fcls = jnp.repeat(cls_ids, k).astype(jnp.float32)
+        kk = min(int(keep_top_k), flat.shape[0]) if keep_top_k > 0 \
+            else flat.shape[0]
+        top, pos = jax.lax.top_k(flat, kk)
+        keep = top > post_threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, fcls[pos], -1.0)[:, None],
+             jnp.where(keep, top, 0.0)[:, None],
+             jnp.where(keep[:, None], fbox[pos], 0.0)], axis=1)
+        return rows, jnp.sum(keep).astype(jnp.int32)
+
+    return jax.vmap(per_image)(bboxes, scores)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (matrix_nms_op.cc:1; paddle.vision.ops.matrix_nms).
+    bboxes [N, M, 4], scores [N, C, M]. Returns (out [N, keep_top_k,
+    6] with rows [class, score, x1, y1, x2, y2] padded by class -1,
+    rois_num [N])."""
+    out, num = apply_op(
+        "matrix_nms", _k_matrix_nms, bboxes, scores,
+        score_threshold=float(score_threshold),
+        post_threshold=float(post_threshold),
+        nms_top_k=int(nms_top_k), keep_top_k=int(keep_top_k),
+        use_gaussian=bool(use_gaussian),
+        gaussian_sigma=float(gaussian_sigma),
+        background_label=int(background_label),
+        normalized=bool(normalized))
+    if return_rois_num:
+        return out, num
+    return out
+
+
+__all__.append("matrix_nms")

@@ -579,3 +579,205 @@ def test_beam_search_decoder_layer_api():
     s = scores.numpy()
     assert (np.diff(s, axis=1) <= 1e-5).all()  # sorted best-first
     assert np.isfinite(s[:, 0]).all()
+
+
+# -- r4 straggler ops: matrix_nms, renorm, op-level beam_search --------------
+
+def _np_matrix_nms(bboxes, scores, score_threshold, post_threshold,
+                   nms_top_k, keep_top_k, use_gaussian, sigma,
+                   background_label, normalized):
+    """Literal numpy transcription of matrix_nms_op.cc:81-150."""
+    N, C, M = scores.shape
+    norm = 0.0 if normalized else 1.0
+
+    def iou(a, b):
+        aa = (a[2] - a[0] + norm) * (a[3] - a[1] + norm)
+        ab = (b[2] - b[0] + norm) * (b[3] - b[1] + norm)
+        x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+        x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(x2 - x1 + norm, 0.0) * max(y2 - y1 + norm, 0.0)
+        return inter / (aa + ab - inter) if inter > 0 else 0.0
+
+    outs, counts = [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = scores[n, c]
+            order = np.argsort(-sc)[:nms_top_k if nms_top_k > 0 else M]
+            s = sc[order]
+            b = bboxes[n][order]
+            kk = len(order)
+            max_iou = np.zeros(kk)
+            ious = np.zeros((kk, kk))
+            for j in range(1, kk):
+                for i in range(j):
+                    ious[j, i] = iou(b[j], b[i])
+                max_iou[j] = ious[j, :j].max() if j else 0.0
+            for j in range(kk):
+                if s[j] <= score_threshold:
+                    continue
+                decay = 1.0
+                for i in range(j):
+                    if use_gaussian:
+                        d = np.exp((max_iou[i] ** 2 - ious[j, i] ** 2)
+                                   * sigma)
+                    else:
+                        d = (1 - ious[j, i]) / (1 - max_iou[i])
+                    decay = min(decay, d)
+                ds = s[j] * decay
+                if ds > post_threshold:
+                    rows.append([c, ds] + list(b[j]))
+        rows.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            rows = rows[:keep_top_k]
+        outs.append(rows)
+        counts.append(len(rows))
+    return outs, counts
+
+
+def test_matrix_nms_matches_cc_reference():
+    from paddle_tpu.vision.ops import matrix_nms
+
+    rng = np.random.RandomState(0)
+    N, C, M = 2, 3, 12
+    centers = rng.rand(N, M, 2) * 50
+    wh = rng.rand(N, M, 2) * 20 + 4
+    bboxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                            axis=-1).astype(np.float32)
+    scores = rng.rand(N, C, M).astype(np.float32)
+
+    for use_gaussian in (False, True):
+        out, num = matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.3, post_threshold=0.2, nms_top_k=8,
+            keep_top_k=6, use_gaussian=use_gaussian,
+            gaussian_sigma=2.0, background_label=0)
+        ref_rows, ref_counts = _np_matrix_nms(
+            bboxes, scores, 0.3, 0.2, 8, 6, use_gaussian, 2.0, 0, True)
+        got = np.asarray(out._value)
+        cnt = np.asarray(num._value)
+        np.testing.assert_array_equal(cnt, ref_counts)
+        for n in range(N):
+            rows = got[n]
+            live = rows[rows[:, 0] >= 0]
+            ref = np.asarray(ref_rows[n], np.float32).reshape(-1, 6)
+            np.testing.assert_allclose(live, ref, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_renorm_matches_numpy():
+    import paddle_tpu.ops.math as m
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 5, 6).astype(np.float32) * 3
+    for p, axis, mx in ((2.0, 1, 2.0), (1.0, 0, 5.0), (2.0, -1, 1.0)):
+        out = np.asarray(m.renorm(paddle.to_tensor(x), p, axis,
+                                  mx)._value)
+        ax = axis % 3
+        red = tuple(i for i in range(3) if i != ax)
+        norms = (np.abs(x) ** p).sum(axis=red, keepdims=True) ** (1 / p)
+        factor = np.where(norms > mx, mx / norms, 1.0)
+        np.testing.assert_allclose(out, x * factor, rtol=1e-5,
+                                   atol=1e-6)
+    # sub-tensors under the bound untouched
+    small = np.full((2, 2), 0.1, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(m.renorm(paddle.to_tensor(small), 2.0, 0,
+                            10.0)._value), small)
+
+
+def test_renorm_gradient():
+    import paddle_tpu.ops.math as m
+
+    x = paddle.to_tensor(np.asarray([[3.0, 4.0]], np.float32))
+    x.stop_gradient = False
+    out = m.renorm(x, 2.0, 0, 1.0)  # norm 5 -> scaled by 1/5
+    np.testing.assert_allclose(np.asarray(out._value),
+                               [[0.6, 0.8]], rtol=1e-6)
+    paddle.sum(out).backward()
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+def test_beam_search_op_level_step():
+    """beam_search_op.cc raw-op parity: one step over [batch*beam, V]
+    accumulated scores; numpy reference does the per-batch-group
+    beam*V top-k."""
+    from paddle_tpu.ops.decode import beam_search
+
+    batch, beam, V = 2, 3, 7
+    rng = np.random.RandomState(2)
+    pre_ids = rng.randint(1, V, (batch * beam, 1)).astype(np.int64)
+    pre_scores = rng.randn(batch * beam, 1).astype(np.float32)
+    scores = rng.randn(batch * beam, V).astype(np.float32)
+
+    sel_ids, sel_scores, parent = beam_search(
+        paddle.to_tensor(pre_ids), paddle.to_tensor(pre_scores),
+        None, paddle.to_tensor(scores), beam_size=beam, end_id=0)
+
+    acc = scores.reshape(batch, beam, V)
+    for b in range(batch):
+        flat = acc[b].reshape(-1)
+        top = np.argsort(-flat)[:beam]
+        np.testing.assert_allclose(
+            np.asarray(sel_scores._value).reshape(batch, beam)[b],
+            flat[top], rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(sel_ids._value).reshape(batch, beam)[b],
+            top % V)
+        np.testing.assert_array_equal(
+            np.asarray(parent._value).reshape(batch, beam)[b],
+            top // V + b * beam)
+
+
+def test_beam_search_finished_lanes_emit_end_id():
+    from paddle_tpu.ops.decode import beam_search
+
+    beam, V = 2, 5
+    end_id = 0
+    pre_ids = np.asarray([[end_id], [3]], np.int64)  # lane 0 finished
+    pre_scores = np.asarray([[-1.0], [-2.0]], np.float32)
+    scores = np.full((2, V), -10.0, np.float32)
+    scores[1, 4] = 5.0  # live lane strongly prefers token 4; its
+    # other candidates (-10) lose to the finished lane's -1
+    sel_ids, sel_scores, parent = beam_search(
+        paddle.to_tensor(pre_ids), paddle.to_tensor(pre_scores),
+        None, paddle.to_tensor(scores), beam_size=beam, end_id=end_id)
+    ids = np.asarray(sel_ids._value).ravel()
+    # the finished lane survives ONLY as end_id with its old score
+    assert 0 in ids and 4 in ids
+    i0 = list(ids).index(0)
+    np.testing.assert_allclose(
+        np.asarray(sel_scores._value).ravel()[i0], -1.0)
+
+
+def test_beam_search_gathers_through_ids():
+    """Reference composition topk -> beam_search: scores are the
+    [batch*beam, K] top-k slice and `ids` carries the vocab ids the
+    columns stand for — selected tokens must gather THROUGH ids."""
+    from paddle_tpu.ops.decode import beam_search
+
+    beam = 2
+    probs = np.asarray([[0.1, 0.0, 0.6, 0.3, 0.0],
+                        [0.0, 0.5, 0.0, 0.1, 0.4],
+                        [0.2, 0.2, 0.2, 0.3, 0.1],
+                        [0.7, 0.0, 0.1, 0.1, 0.1]], np.float32)
+    k = 2
+    top_ids = np.argsort(-probs, axis=1)[:, :k]
+    top_scores = np.take_along_axis(probs, top_ids, axis=1)
+    pre_ids = np.full((4, 1), 9, np.int64)  # none finished
+    pre_scores = np.zeros((4, 1), np.float32)
+    sel_ids, sel_scores, parent = beam_search(
+        paddle.to_tensor(pre_ids), paddle.to_tensor(pre_scores),
+        paddle.to_tensor(top_ids.astype(np.int64)),
+        paddle.to_tensor(top_scores), beam_size=beam, end_id=0)
+    ids = np.asarray(sel_ids._value).reshape(2, beam)
+    par = np.asarray(parent._value).reshape(2, beam)
+    # group 0 (rows 0,1): best candidates are vocab 2 (0.6, row 0)
+    # and vocab 1 (0.5, row 1) — VOCAB ids, not top-k positions
+    np.testing.assert_array_equal(ids[0], [2, 1])
+    np.testing.assert_array_equal(par[0], [0, 1])
+    # group 1 (rows 2,3): vocab 0 (0.7, row 3), vocab 3 (0.3, row 2)
+    np.testing.assert_array_equal(ids[1], [0, 3])
+    np.testing.assert_array_equal(par[1], [3, 2])

@@ -111,11 +111,17 @@ SMOKE = {
                         np.stack([C34.real, C34.imag], -1)),
     "sgn": lambda: (paddle.sgn(T(A)), np.sign(A)),
     "erfinv": lambda: (
-        paddle.erfinv(T(np.clip(A, -0.9, 0.9))), None),
-    "i0": lambda: (paddle.i0(T(A)), None),
-    "i0e": lambda: (paddle.i0e(T(A)), None),
-    "i1": lambda: (paddle.i1(T(A)), None),
-    "i1e": lambda: (paddle.i1e(T(A)), None),
+        paddle.erfinv(T(np.clip(A, -0.9, 0.9))),
+        __import__("scipy.special", fromlist=["x"]).erfinv(
+            np.clip(A, -0.9, 0.9))),
+    "i0": lambda: (paddle.i0(T(A)),
+                   __import__("scipy.special", fromlist=["x"]).i0(A)),
+    "i0e": lambda: (paddle.i0e(T(A)),
+                    __import__("scipy.special", fromlist=["x"]).i0e(A)),
+    "i1": lambda: (paddle.i1(T(A)),
+                   __import__("scipy.special", fromlist=["x"]).i1(A)),
+    "i1e": lambda: (paddle.i1e(T(A)),
+                    __import__("scipy.special", fromlist=["x"]).i1e(A)),
     "nanmean": lambda: (paddle.nanmean(T(_with_nan())),
                         np.nanmean(_with_nan())),
     "nansum": lambda: (paddle.nansum(T(_with_nan())),
@@ -161,12 +167,14 @@ SMOKE = {
     "reshape_": lambda: (paddle.reshape_(T(A), [4, 3]), A.reshape(4, 3)),
     "squeeze_": lambda: (paddle.squeeze_(T(A[None]), 0), A),
     "unsqueeze_": lambda: (paddle.unsqueeze_(T(A), 0), A[None]),
-    "softmax_": lambda: (F.softmax_(T(A)), None),
+    "softmax_": lambda: (F.softmax_(T(A)), _softmax_np(A)),
     "view": lambda: (paddle.view(T(A), [4, 3]), A.reshape(4, 3)),
     "view_as": lambda: (paddle.view_as(T(A), T(A.reshape(4, 3))),
                         A.reshape(4, 3)),
     "as_strided": lambda: (
-        paddle.as_strided(T(A), [3, 2], [4, 1]), None),
+        paddle.as_strided(T(A), [3, 2], [4, 1]),
+        np.lib.stride_tricks.as_strided(
+            A, (3, 2), (4 * A.itemsize, A.itemsize)).copy()),
     "expand": lambda: (paddle.expand(T(V4), [3, 4]),
                        np.broadcast_to(V4, (3, 4))),
     "expand_as": lambda: (paddle.expand_as(T(V4), T(A)),
@@ -242,21 +250,27 @@ SMOKE = {
     "repeat_interleave": lambda: (
         paddle.repeat_interleave(T(A), 2, axis=1),
         np.repeat(A, 2, axis=1)),
-    "unfold": lambda: (F.unfold(T(IMG), 3, strides=2), None),
+    "unfold": lambda: (
+        F.unfold(T(IMG), 3, strides=2),
+        np.lib.stride_tricks.sliding_window_view(
+            IMG, (3, 3), axis=(2, 3))[:, :, ::2, ::2]
+        .transpose(0, 1, 4, 5, 2, 3).reshape(2, 27, 9)),
     "assign": lambda: (paddle.assign(T(A)), A),
     "clone": lambda: (T(A).clone(), A),
-    "tolist": lambda: (paddle.tolist(T(V4)), None),
+    "tolist": lambda: (paddle.tolist(T(V4)), V4.tolist()),
     "numel": lambda: (paddle.numel(T(A)), 12),
     "is_empty": lambda: (paddle.is_empty(T(np.zeros((0,)))), True),
     "is_tensor": lambda: (paddle.is_tensor(T(A)), True),
     "shard_index": lambda: (
-        paddle.shard_index(T(I4), 8, 2, 0, -1), None),
+        paddle.shard_index(T(I4), 8, 2, 0, -1),
+        np.where(I4 // 4 == 0, I4 % 4, -1)),
     "diag_embed": lambda: (paddle.diag_embed(T(V4)), np.diag(V4)),
     "diagflat": lambda: (paddle.diagflat(T(V4)), np.diagflat(V4)),
     "diagonal": lambda: (paddle.diagonal(T(SQ)), np.diagonal(SQ)),
     # ---- creation ----
-    "empty": lambda: (paddle.empty([2, 3]), None),
-    "empty_like": lambda: (paddle.empty_like(T(A)), None),
+    "empty": lambda: (paddle.empty([2, 3]),
+                      np.zeros((2, 3))),  # empty == zeros by design
+    "empty_like": lambda: (paddle.empty_like(T(A)), np.zeros_like(A)),
     "full_like": lambda: (paddle.full_like(T(A), 7.0),
                           np.full_like(A, 7.0)),
     "ones_like": lambda: (paddle.ones_like(T(A)), np.ones_like(A)),
@@ -366,12 +380,15 @@ SMOKE = {
         F.log_softmax(T(A), axis=1),
         A - A.max(1, keepdims=True)
         - np.log(np.exp(A - A.max(1, keepdims=True)).sum(1, keepdims=True))),
-    "maxout": lambda: (F.maxout(T(IMG.reshape(2, 3, 64)[:, :2]), 2), None),
+    "maxout": lambda: (
+        F.maxout(T(IMG.reshape(2, 3, 64)[:, :2]), 2),
+        IMG.reshape(2, 3, 64)[:, :2].reshape(2, 1, 2, 64).max(2)),
     "prelu": lambda: (F.prelu(T(A), T(np.asarray([0.2], np.float32))),
                       np.where(A > 0, A, 0.2 * A)),
-    "rrelu": lambda: (F.rrelu(T(A), training=False), None),
+    "rrelu": lambda: (F.rrelu(T(A), training=False),
+                      np.where(A >= 0, A, A * ((0.125 + 1 / 3) / 2))),
     "swish": lambda: (F.swish(T(A)), A / (1 + np.exp(-A))),
-    "stanh": lambda: (F.stanh(T(A)), None),
+    "stanh": lambda: (F.stanh(T(A)), 1.7159 * np.tanh(0.67 * A)),
     "thresholded_relu": lambda: (F.thresholded_relu(T(A), 1.0),
                                  np.where(A > 1.0, A, 0.0)),
     # ---- conv / pool family ----
@@ -398,13 +415,17 @@ SMOKE = {
     "max_pool2d": lambda: (
         F.max_pool2d(T(IMG), 2),
         IMG.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))),
-    "max_pool3d": lambda: (F.max_pool3d(T(IMG3D), 2), None),
+    "max_pool3d": lambda: (
+        F.max_pool3d(T(IMG3D), 2),
+        IMG3D.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))),
     "avg_pool1d": lambda: (F.avg_pool1d(T(IMG1D), 2),
                            IMG1D.reshape(2, 3, 4, 2).mean(-1)),
     "avg_pool2d": lambda: (
         F.avg_pool2d(T(IMG), 2),
         IMG.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))),
-    "avg_pool3d": lambda: (F.avg_pool3d(T(IMG3D), 2), None),
+    "avg_pool3d": lambda: (
+        F.avg_pool3d(T(IMG3D), 2),
+        IMG3D.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))),
     "adaptive_avg_pool1d": lambda: (
         F.adaptive_avg_pool1d(T(IMG1D), 4),
         IMG1D.reshape(2, 3, 4, 2).mean(-1)),
@@ -412,7 +433,8 @@ SMOKE = {
         F.adaptive_avg_pool2d(T(IMG), 4),
         IMG.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))),
     "adaptive_avg_pool3d": lambda: (
-        F.adaptive_avg_pool3d(T(IMG3D), 2), None),
+        F.adaptive_avg_pool3d(T(IMG3D), 2),
+        IMG3D.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))),
     "adaptive_max_pool1d": lambda: (
         F.adaptive_max_pool1d(T(IMG1D), 4),
         IMG1D.reshape(2, 3, 4, 2).max(-1)),
@@ -420,7 +442,8 @@ SMOKE = {
         F.adaptive_max_pool2d(T(IMG), 4),
         IMG.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))),
     "adaptive_max_pool3d": lambda: (
-        F.adaptive_max_pool3d(T(IMG3D), 2), None),
+        F.adaptive_max_pool3d(T(IMG3D), 2),
+        IMG3D.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))),
     "grid_sample": lambda: (F.grid_sample(
         T(IMG), T(np.zeros((2, 4, 4, 2), np.float32))), None),
     "affine_grid": lambda: (F.affine_grid(
@@ -439,7 +462,7 @@ SMOKE = {
     "instance_norm": lambda: (F.instance_norm(T(IMG)),
                               _instance_norm_ref()),
     "local_response_norm": lambda: (
-        F.local_response_norm(T(IMG), 3), None),
+        F.local_response_norm(T(IMG), 3), _lrn_np(IMG, 3)),
     "rms_norm": lambda: (
         F.rms_norm(T(A), T(np.ones(4, np.float32))),
         A / np.sqrt((A ** 2).mean(-1, keepdims=True) + 1e-6)),
@@ -449,7 +472,10 @@ SMOKE = {
     # ---- losses ----
     "mse_loss": lambda: (F.mse_loss(T(A), T(B_)), ((A - B_) ** 2).mean()),
     "l1_loss": lambda: (F.l1_loss(T(A), T(B_)), np.abs(A - B_).mean()),
-    "smooth_l1_loss": lambda: (F.smooth_l1_loss(T(A), T(B_)), None),
+    "smooth_l1_loss": lambda: (
+        F.smooth_l1_loss(T(A), T(B_)),
+        np.mean(np.where(np.abs(A - B_) < 1.0,
+                         0.5 * (A - B_) ** 2, np.abs(A - B_) - 0.5))),
     "nll_loss": lambda: (
         F.nll_loss(T(np.log(_softmax_np(A))), T(I4[:, 0])),
         -np.log(_softmax_np(A))[np.arange(3), I4[:, 0]].mean()),
@@ -462,12 +488,15 @@ SMOKE = {
         F.binary_cross_entropy_with_logits(T(A), T(B34.astype(np.float32))),
         np.mean(np.maximum(A, 0) - A * B34 + np.log1p(np.exp(-np.abs(A))))),
     "softmax_with_cross_entropy": lambda: (
-        F.softmax_with_cross_entropy(T(A), T(I4[:, :1])), None),
+        F.softmax_with_cross_entropy(T(A), T(I4[:, :1])),
+        -np.log(_softmax_np(A))[np.arange(3), I4[:, 0]][:, None]),
     "margin_ranking_loss": lambda: (
         F.margin_ranking_loss(T(V4), T(V4 * 0.5),
                               T(np.ones(4, np.float32))), None),
     "hinge_embedding_loss": lambda: (
-        F.hinge_embedding_loss(T(A), T(np.sign(B_))), None),
+        F.hinge_embedding_loss(T(A), T(np.sign(B_))),
+        np.mean(np.where(np.sign(B_) == 1, A,
+                         np.maximum(0.0, 1.0 - A)))),
     "cosine_similarity": lambda: (
         F.cosine_similarity(T(A), T(B_), axis=1),
         (A * B_).sum(1) / (np.linalg.norm(A, axis=1)
@@ -482,13 +511,18 @@ SMOKE = {
         F.log_loss(T(np.clip(_softmax_np(A), 0.01, 0.99)),
                    T(B34.astype(np.float32))), None),
     "sigmoid_focal_loss": lambda: (
-        F.sigmoid_focal_loss(T(A), T(B34.astype(np.float32))), None),
+        F.sigmoid_focal_loss(T(A), T(B34.astype(np.float32))),
+        _focal_np(A, B34.astype(np.float32))),
     "dice_loss": lambda: (
-        F.dice_loss(T(_softmax_np(A)), T(I4[:, :1])), None),
+        F.dice_loss(T(_softmax_np(A)), T(I4[:, :1])),
+        _dice_np(_softmax_np(A), I4[:, 0])),
     "npair_loss": lambda: (
         F.npair_loss(T(A), T(B_), T(I4[:, 0])), None),
     "triplet_margin_loss": lambda: (
-        F.triplet_margin_loss(T(A), T(B_), T(A + B_)), None),
+        F.triplet_margin_loss(T(A), T(B_), T(A + B_)),
+        np.mean(np.maximum(
+            np.linalg.norm(A - B_, axis=1)
+            - np.linalg.norm(A - (A + B_), axis=1) + 1.0, 0.0))),
     "triplet_margin_with_distance_loss": lambda: (
         F.triplet_margin_with_distance_loss(T(A), T(B_), T(A + B_)), None),
     "soft_margin_loss": lambda: (
@@ -498,9 +532,11 @@ SMOKE = {
         F.multi_label_soft_margin_loss(T(A), T(B34.astype(np.float32))),
         None),
     "poisson_nll_loss": lambda: (
-        F.poisson_nll_loss(T(POS), T(POS)), None),
+        F.poisson_nll_loss(T(POS), T(POS)),
+        np.mean(np.exp(POS) - POS * POS)),
     "gaussian_nll_loss": lambda: (
-        F.gaussian_nll_loss(T(A), T(B_), T(POS)), None),
+        F.gaussian_nll_loss(T(A), T(B_), T(POS)),
+        np.mean(0.5 * (np.log(POS) + (A - B_) ** 2 / POS))),
     "square_error_cost": lambda: (F.square_error_cost(T(A), T(B_)),
                                   (A - B_) ** 2),
     "ctc_loss": lambda: (
@@ -590,6 +626,31 @@ def _with_inf():
     return x
 
 
+def _lrn_np(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    """Across-channel LRN, NCHW (local_response_norm numpy ref)."""
+    half = size // 2
+    sq = np.pad(x ** 2, ((0, 0), (half, size - 1 - half),
+                         (0, 0), (0, 0)))
+    s = np.stack([sq[:, c:c + size].sum(axis=1)
+                  for c in range(x.shape[1])], axis=1)
+    return x / (k + alpha * s / size) ** beta
+
+
+def _focal_np(x, y, alpha=0.25, gamma=2.0):
+    p = 1 / (1 + np.exp(-x))
+    ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    return np.sum(a_t * (1 - p_t) ** gamma * ce)
+
+
+def _dice_np(p, label, eps=1e-5):
+    oh = np.eye(p.shape[-1], dtype=p.dtype)[label]
+    inter = (p * oh).sum(axis=1)
+    union = p.sum(axis=1) + oh.sum(axis=1)
+    return np.mean(1 - (2 * inter + eps) / (union + eps))
+
+
 def _softmax_np(x):
     e = np.exp(x - x.max(-1, keepdims=True))
     return e / e.sum(-1, keepdims=True)
@@ -644,39 +705,69 @@ def _stat(t, expect_mean, tol):
     return t
 
 
-# Ops exercised (with refs/grads) by OTHER test files — file named so
-# the claim is checkable.
-COVERED_ELSEWHERE = {
-    # tests/test_op_sweep.py tables
-    "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt",
-    "abs", "floor", "ceil", "round", "sign", "sin", "cos", "tan", "asin",
-    "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
-    "erf", "square", "reciprocal", "digamma", "lgamma", "neg", "trunc",
-    "frac", "add", "subtract", "multiply", "divide", "pow", "maximum",
-    "minimum", "fmax", "fmin", "atan2", "sum", "mean", "max", "min",
-    "prod", "std", "var", "median", "quantile", "all", "logsumexp",
-    "amax", "amin", "relu", "relu6", "sigmoid", "softmax", "gelu", "silu",
-    "elu", "selu", "leaky_relu", "hardswish", "hardsigmoid", "hardtanh",
-    "hardshrink", "softshrink", "softplus", "softsign", "tanhshrink",
-    "mish", "equal", "not_equal", "greater_than", "greater_equal",
-    "less_than", "less_equal", "concat", "stack", "split", "reshape",
-    "transpose", "squeeze", "unsqueeze", "flip", "roll", "tile",
-    "gather", "index_select", "masked_select", "where", "clip", "cumsum",
-    "cumprod", "cummax", "kron", "diff", "argmax", "argmin", "argsort",
-    "sort", "topk", "kthvalue", "unique", "matmul", "dot",
-    "t", "norm", "det", "cholesky", "cross", "trace",
-    "einsum", "zeros", "ones", "full", "arange", "linspace", "eye",
-    "diag", "meshgrid", "to_tensor",
-    "zeros_like", "rand", "randn", "randint", "seed", "unstack",
-    # tests/test_ops.py + test_nn.py
-    "batch_norm", "layer_norm", "conv2d", "one_hot", "pad",
-    "cross_entropy",
-    # tests/test_detection_sequence_ops.py
-    "sequence_pool", "sequence_softmax", "sequence_expand",
-    "sequence_expand_as", "sequence_conv", "sequence_reverse",
-    "sequence_pad", "sequence_unpad", "sequence_slice",
-    "sequence_enumerate", "edit_distance",
+# Ops exercised (with refs/grads) by OTHER test files. Structured as
+# op -> covering file and VERIFIED at collection time
+# (test_covered_elsewhere_claims_hold greps the named file for the op
+# symbol), so an op can no longer lose its real test while the gate
+# stays green (r3 weak #7).
+_ELSEWHERE_FILES = {
+    "test_op_sweep.py": [
+        "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt",
+        "rsqrt", "abs", "floor", "ceil", "round", "sign", "sin", "cos",
+        "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+        "acosh", "atanh", "erf", "square", "reciprocal", "digamma",
+        "lgamma", "neg", "trunc", "frac", "add", "subtract", "multiply",
+        "divide", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+        "sum", "mean", "max", "min", "prod", "std", "var", "median",
+        "quantile", "all", "logsumexp", "amax", "amin", "relu", "relu6",
+        "sigmoid", "gelu", "silu", "elu", "selu",
+        "leaky_relu", "hardswish", "hardsigmoid", "hardtanh",
+        "hardshrink", "softshrink", "softplus", "softsign",
+        "tanhshrink", "mish", "equal", "not_equal", "greater_than",
+        "greater_equal", "less_than", "less_equal", "concat", "stack",
+        "split", "reshape", "transpose", "squeeze", "unsqueeze",
+        "flip", "roll", "tile", "gather", "index_select", "one_hot",
+        "masked_select", "where", "clip", "cumsum", "cumprod",
+        "cummax", "kron", "diff", "argmax", "argmin", "argsort",
+        "sort", "topk", "kthvalue", "unique", "matmul", "dot", "t",
+        "norm", "cholesky", "cross", "trace", "einsum", "zeros",
+        "ones", "full", "arange", "linspace", "eye", "diag",
+        "meshgrid", "to_tensor", "zeros_like", "randn",
+        "randint", "unstack",
+    ],
+    "test_ops.py": ["batch_norm", "layer_norm", "conv2d", "pad",
+                    "cross_entropy", "softmax", "det", "rand",
+                    "seed"],
+    "test_detection_sequence_ops.py": [
+        "sequence_pool", "sequence_softmax", "sequence_expand",
+        "sequence_expand_as", "sequence_conv", "sequence_reverse",
+        "sequence_pad", "sequence_unpad", "sequence_slice",
+        "sequence_enumerate", "edit_distance", "renorm", "beam_search",
+    ],
 }
+COVERED_ELSEWHERE = {n: f for f, names in _ELSEWHERE_FILES.items()
+                     for n in names}
+
+
+def test_covered_elsewhere_claims_hold():
+    """Every COVERED_ELSEWHERE claim is verified: the named file must
+    actually reference the op symbol (r3 weak #7 — the hand-kept list
+    had no cross-check)."""
+    import os
+    import re
+
+    here = os.path.dirname(__file__)
+    contents = {f: open(os.path.join(here, f)).read()
+                for f in _ELSEWHERE_FILES}
+    broken = []
+    for op, fname in COVERED_ELSEWHERE.items():
+        if not re.search(rf"\b{re.escape(op)}\b", contents[fname]):
+            broken.append(f"{op} -> {fname}")
+    assert not broken, (
+        "COVERED_ELSEWHERE claims reference files that do not mention "
+        f"the op: {broken}")
+
+
 # NOTE: nn.functional-only and Tensor-method surfaces (dropout, linear,
 # interpolate, inplace add_/exp_/... variants) are outside the ops.*
 # registry this gate enumerates; they are exercised by test_nn.py /
@@ -703,8 +794,8 @@ def test_registry_fully_covered():
 
 
 def test_no_stale_entries():
-    stale = sorted((set(SMOKE) | set(EXEMPT) | COVERED_ELSEWHERE)
-                   - set(REG))
+    stale = sorted((set(SMOKE) | set(EXEMPT)
+                    | set(COVERED_ELSEWHERE)) - set(REG))
     assert not stale, f"entries for nonexistent ops: {stale}"
 
 
