@@ -136,14 +136,16 @@ def main():
     x = jnp.asarray(rng.randn(args.batch, 224, 224, 3), jnp.bfloat16)
     labels = jnp.asarray(rng.randint(0, 1000, (args.batch,)), jnp.int32)
     params, momentum, loss = train_step(params, momentum, x, labels)
-    loss.block_until_ready()  # compile
+    float(np.asarray(loss))  # compile + TRUE sync (device-get:
+    # block_until_ready returns early on the tunnel backend —
+    # see gemm_probe.py)
     dts = []
     for _ in range(args.windows):
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, momentum, loss = train_step(params, momentum, x,
                                                 labels)
-        loss.block_until_ready()
+        float(np.asarray(loss))
         dts.append((time.perf_counter() - t0) / args.steps)
     dt = float(np.median(dts))
     print(json.dumps({

@@ -32,10 +32,16 @@ Implemented transforms (r4 closes the r3 gaps):
     (convert_call_func.py)
   * print/len               -> convert_print / convert_len
 
-Remaining documented restriction: no `return` inside converted
-control flow (fallback to trace-only conversion). Closures are
-supported by factory re-binding (cells captured by value at conversion
-time — the reference's limitation too).
+  * return in control flow   -> return-flag + value variables
+    (return_transformer.py technique): `return expr` becomes
+    `__jst_rv = expr; __jst_rf = True`, trailing statements guard on
+    the flag, loops break on it, and the function tail returns
+    `finalize_ret(rf, rv)`. Traced early returns select between
+    branch values (a traced function must return on every path —
+    Python's implicit None has no tensor representation).
+
+Closures are supported by factory re-binding (cells captured by value
+at conversion time — the reference's limitation too).
 """
 from __future__ import annotations
 
@@ -217,6 +223,40 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
             return g
 
         pv = jnp.reshape(jnp.asarray(p), ()).astype(bool)
+        if any(str(n).startswith("__jst_rv") for n in names):
+            # early-return pattern: the return-value slot may be
+            # UNDEF on the path that has not returned yet. lax.cond
+            # cannot thread a missing value, so evaluate both (pure)
+            # branches and SELECT — the flag guards any read of the
+            # zero-filled placeholder, so the substitution is
+            # unobservable (return_transformer semantics).
+            t_vals = list(true_fn())
+            f_vals = list(false_fn())
+            outs = []
+            for i, (tv, fv) in enumerate(zip(t_vals, f_vals)):
+                n = names[i] if i < len(names) else f"#{i}"
+                t_un = isinstance(tv, _Undefined)
+                f_un = isinstance(fv, _Undefined)
+                if t_un and f_un:
+                    outs.append(tv)  # never assigned on either path;
+                    continue         # stays UNDEF (loud if read)
+                if t_un or f_un:
+                    if not str(n).startswith("__jst_rv"):
+                        raise ValueError(
+                            f"dy2static: variable {n!r} is assigned "
+                            "in only one branch of a traced "
+                            "conditional but used afterwards — "
+                            "assign it in both branches")
+                    other = _to_jax_tree(fv if t_un else tv)
+                    zero = jax.tree_util.tree_map(jnp.zeros_like,
+                                                  other)
+                    tv = zero if t_un else _to_jax_tree(tv)
+                    fv = zero if f_un else _to_jax_tree(fv)
+                else:
+                    tv, fv = _to_jax_tree(tv), _to_jax_tree(fv)
+                outs.append(_from_jax_tree(jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(pv, a, b), tv, fv)))
+            return tuple(outs)
         outs = jax.lax.cond(pv, wrap_branch(true_fn),
                             wrap_branch(false_fn), None)
         return tuple(_from_jax_tree(o) for o in outs)
@@ -318,6 +358,24 @@ def _traced_while(cond_fn, body_fn, init_vals):
     else:
         outs = jax.lax.while_loop(cond_c, body_c, init)
     return tuple(_from_jax_tree(o) for o in outs)
+
+
+def finalize_ret(rf, rv):
+    """Function-tail return selector (return_transformer analog): flag
+    concrete -> Python semantics exactly (None when no return ran);
+    flag traced -> the function returned on every traced path (the
+    transform guarantees rv is bound there)."""
+    if isinstance(rv, _Undefined):
+        if _is_traced(rf):
+            raise ValueError(
+                "dy2static: a traced-condition path reaches the end of "
+                "the function without returning — traced functions "
+                "must return a value on every path (Python's implicit "
+                "None has no tensor representation)")
+        return None
+    if not _is_traced(rf) and not _truthy(_unwrap(rf)):
+        return None
+    return rv
 
 
 def convert_print(*args, **kwargs):
@@ -763,6 +821,74 @@ def _rewrite_break_continue(stmts, brk, cont, flags):
     return out
 
 
+def _has_nested_return(fdef):
+    """True when a Return sits INSIDE control flow (a straight-line
+    tail return needs no transform)."""
+    for stmt in fdef.body:
+        if isinstance(stmt, (ast.If, ast.While, ast.For)):
+            for n in _walk_shallow_fn(stmt):
+                if isinstance(n, ast.Return):
+                    return True
+    return False
+
+
+def _walk_shallow_fn(node):
+    """Walk without descending into nested function defs (returns in
+    those belong to THEM)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _rewrite_returns(stmts, rf, rv):
+    """return_transformer.py technique: `return expr` -> rv/rf
+    assignments; statements after a may-return statement guard on
+    `not rf`; loops whose body may return get `if rf: break` appended
+    (the break machinery then exits them)."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(_assign(rv, s.value if s.value is not None
+                               else ast.Constant(value=None)))
+            out.append(_assign(rf, ast.Constant(value=True)))
+            return out  # rest unreachable
+        may_ret = any(isinstance(n, ast.Return)
+                      for n in _walk_shallow_fn(s))
+        if may_ret:
+            if isinstance(s, ast.If):
+                s = ast.If(test=s.test,
+                           body=_rewrite_returns(s.body, rf, rv)
+                           or [ast.Pass()],
+                           orelse=_rewrite_returns(s.orelse, rf, rv))
+            elif isinstance(s, (ast.While, ast.For)):
+                new_body = _rewrite_returns(s.body, rf, rv)
+                new_body.append(ast.If(
+                    test=_name(rf), body=[ast.Break()], orelse=[]))
+                if isinstance(s, ast.While):
+                    s = ast.While(test=s.test, body=new_body,
+                                  orelse=s.orelse)
+                else:
+                    s = ast.For(target=s.target, iter=s.iter,
+                                body=new_body, orelse=s.orelse)
+            else:
+                raise _Unsupported(
+                    "return inside try/with in converted control flow")
+            out.append(s)
+            rest = _rewrite_returns(stmts[i + 1:], rf, rv)
+            if rest:
+                out.append(ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=_name(rf)),
+                    body=rest, orelse=[]))
+            return out
+        out.append(s)
+    return out
+
+
 def _loaded_names(node):
     """All Name-Load identifiers within `node`."""
     out = set()
@@ -898,7 +1024,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                      if (n in assigned_t and n in assigned_f)
                      or n in outside_loads
                      or n.startswith("__jst_brk_")
-                     or n.startswith("__jst_cont_")]
+                     or n.startswith("__jst_cont_")
+                     or n.startswith("__jst_rf_")
+                     or n.startswith("__jst_rv_")]
         tname, fname = self._fresh("true"), self._fresh("false")
         # each branch takes the assigned names as DEFAULT arguments
         # bound at def time: a branch can read a name it also assigns
@@ -1195,6 +1323,24 @@ def ast_transform(func, for_call=False):
 
     fdef.decorator_list = [d for d in fdef.decorator_list
                            if not _is_to_static_deco(d)]
+    if _has_nested_return(fdef):
+        # return transformer (pre-pass): rewrite BEFORE control-flow
+        # conversion so the synthesized breaks/guards convert too
+        try:
+            rf, rv = "__jst_rf_0", "__jst_rv_0"
+            fdef.body = (
+                [_assign(rf, ast.Constant(value=False)),
+                 _assign(rv, ast.Attribute(
+                     value=ast.Name(id="_jst", ctx=ast.Load()),
+                     attr="UNDEF", ctx=ast.Load()))]
+                + _rewrite_returns(fdef.body, rf, rv)
+                + [ast.Return(value=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id="_jst", ctx=ast.Load()),
+                        attr="finalize_ret", ctx=ast.Load()),
+                    args=[_name(rf), _name(rv)], keywords=[]))])
+        except _Unsupported:
+            return None
     has_cf = any(isinstance(n, (ast.If, ast.While, ast.For))
                  for n in ast.walk(fdef))
     if not has_cf:

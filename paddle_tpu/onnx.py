@@ -1,12 +1,339 @@
-"""paddle.onnx (reference: python/paddle/onnx/export.py via paddle2onnx).
-Export path: jax → StableHLO is the TPU-native serialization; ONNX
-export requires the external paddle2onnx tool and is gated."""
+"""paddle.onnx — real ONNX model export.
+
+Parity target: python/paddle/onnx/export.py (which shells out to the
+external paddle2onnx tool). That tool is unavailable offline, and the
+`onnx` python package is not in this environment either — so this
+module writes the ONNX protobuf WIRE FORMAT directly (the encoding is
+simple: varint tags + length-delimited submessages; field numbers from
+the public onnx.proto3 schema). The output is a standard `.onnx`
+ModelProto loadable by onnxruntime / netron.
+
+Pipeline: the layer records into a static Program (the same recorder
+`paddle.static` uses), and each OpRecord maps to ONNX node(s):
+
+    conv2d      -> Conv           linear -> Gemm (2-D) / MatMul+Add
+    max_pool2d  -> MaxPool/AveragePool      relu/sigmoid/tanh ->
+    flatten     -> Flatten        softmax -> Softmax     elementwise
+    reshape     -> Reshape        add/multiply -> Add/Mul
+    batch_norm  -> BatchNormalization (inference form)
+
+Concrete parameter leaves become graph initializers; feeds become
+graph inputs. Unsupported op types raise with the op name (explicit
+failure, not silent truncation of the graph).
+"""
 from __future__ import annotations
+
+import struct
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+# ---------------------------------------------------------------------------
+# minimal protobuf wire-format writer (onnx.proto3 field numbers)
+# ---------------------------------------------------------------------------
+
+def _varint(n):
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _f_int(field, value):
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field, data):
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _f_str(field, s):
+    return _f_bytes(field, s.encode("utf-8"))
+
+
+def _f_msg(field, payload):
+    return _f_bytes(field, payload)
+
+
+def _f_float(field, v):
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+# ONNX TensorProto.DataType
+_DTYPES = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+           "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+           "bfloat16": 16}
+
+
+def _tensor_proto(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = _DTYPES.get(str(arr.dtype))
+    if dt is None:
+        raise ValueError(f"onnx export: unsupported dtype {arr.dtype}")
+    out = b"".join(_f_int(1, d) for d in arr.shape)
+    out += _f_int(2, dt)
+    out += _f_str(8, name)
+    out += _f_bytes(9, arr.tobytes())
+    return out
+
+
+def _attr(name, value):
+    """AttributeProto for int / float / ints / string."""
+    out = _f_str(1, name)
+    if isinstance(value, bool):
+        out += _f_int(3, int(value)) + _f_int(20, 2)       # INT
+    elif isinstance(value, int):
+        out += _f_int(3, value) + _f_int(20, 2)            # INT
+    elif isinstance(value, float):
+        out += _f_float(2, value) + _f_int(20, 1)          # FLOAT
+    elif isinstance(value, str):
+        out += _f_bytes(4, value.encode()) + _f_int(20, 3)  # STRING
+    elif isinstance(value, (list, tuple)):
+        out += b"".join(_f_int(8, int(v)) for v in value)
+        out += _f_int(20, 7)                               # INTS
+    else:
+        raise TypeError(f"onnx attr {name}: {type(value)}")
+    return out
+
+
+def _node(op_type, inputs, outputs, name="", attrs=None):
+    out = b"".join(_f_str(1, i) for i in inputs)
+    out += b"".join(_f_str(2, o) for o in outputs)
+    out += _f_str(3, name or (op_type + "_" + outputs[0]))
+    out += _f_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _f_msg(5, _attr(k, v))
+    return out
+
+
+def _value_info(name, shape, elem_type=1):
+    dims = b""
+    for d in shape:
+        if d is None or (isinstance(d, int) and d < 0):
+            dims += _f_msg(1, _f_str(2, "batch"))
+        else:
+            dims += _f_msg(1, _f_int(1, int(d)))
+    ttype = _f_int(1, elem_type) + _f_msg(2, dims)
+    return _f_str(1, name) + _f_msg(2, _f_msg(1, ttype))
+
+
+# ---------------------------------------------------------------------------
+# OpRecord -> ONNX node lowering
+# ---------------------------------------------------------------------------
+
+def _onnx_pads(pads):
+    """[(t, b), (l, r)] -> pads attr [t, l, b, r], or 'SAME'/'VALID'
+    strings -> the ONNX auto_pad attribute."""
+    if isinstance(pads, str):
+        return {"auto_pad": {"SAME": "SAME_UPPER",
+                             "VALID": "VALID"}[pads.upper()]}
+    pairs = [tuple(int(x) for x in p) for p in pads]
+    return {"pads": [p[0] for p in pairs] + [p[1] for p in pairs]}
+
+
+def _lower_op(op, names, new_name, add_init):
+    """Returns a list of NodeProto payloads for one OpRecord."""
+    t = op.type
+    ins = names["in"]
+    outs = names["out"]
+    if t == "conv2d":
+        kw = op.kwargs
+        attrs = {"strides": list(kw["stride"]),
+                 "dilations": list(kw["dilation"]),
+                 "group": int(kw["groups"])}
+        attrs.update(_onnx_pads(kw["padding"]))
+        return [_node("Conv", ins[:3] if ins[2] else ins[:2], outs,
+                      attrs=attrs)]
+    if t == "max_pool2d" or t == "avg_pool2d":
+        kw = op.kwargs
+        kind = "MaxPool" if kw.get("kind", "max") == "max" \
+            else "AveragePool"
+        attrs = {"kernel_shape": list(kw["kernel"]),
+                 "strides": list(kw["stride"])}
+        attrs.update(_onnx_pads(kw["pad"]))
+        return [_node(kind, ins[:1], outs, attrs=attrs)]
+    if t == "linear":
+        x, w, b = ins[0], ins[1], ins[2]
+        x_rank = names.get("in_ranks", [2])[0]
+        if b and x_rank == 2:
+            return [_node("Gemm", [x, w, b], outs,
+                          attrs={"alpha": 1.0, "beta": 1.0,
+                                 "transA": 0, "transB": 0})]
+        if not b:
+            return [_node("MatMul", [x, w], outs)]
+        # N-D input: ONNX Gemm is 2-D only -> MatMul + Add
+        mm = new_name("mm")
+        return [_node("MatMul", [x, w], [mm]),
+                _node("Add", [mm, b], outs)]
+    if t == "matmul":
+        return [_node("MatMul", ins[:2], outs)]
+    if t == "flatten":
+        start = int(op.kwargs.get("start", 0))
+        stop = int(op.kwargs.get("stop", -1))
+        in_rank = names.get("in_ranks", [None])[0]
+        out_shape = names.get("out_shapes", [None])[0]
+        if start == 1 and (stop == -1 or (in_rank is not None
+                                          and stop == in_rank - 1)):
+            return [_node("Flatten", ins[:1], outs,
+                          attrs={"axis": 1})]
+        # partial flatten: ONNX Flatten always yields 2-D — lower to
+        # Reshape with the STATIC output shape instead
+        if out_shape is None or any(d is None or d < 0
+                                    for d in out_shape):
+            raise NotImplementedError(
+                "onnx export: partial flatten with dynamic dims has "
+                "no ONNX lowering (Flatten is 2-D only)")
+        shp = new_name("shape")
+        add_init(shp, np.asarray(out_shape, np.int64))
+        return [_node("Reshape", [ins[0], shp], outs)]
+    if t == "reshape":
+        shape = [int(s) for s in op.kwargs.get("shape", [])]
+        shp_name = new_name("shape")
+        add_init(shp_name, np.asarray(shape, np.int64))
+        return [_node("Reshape", [ins[0], shp_name], outs)]
+    if t in ("relu", "sigmoid", "tanh", "exp", "sqrt", "abs", "floor",
+             "ceil", "neg", "identity"):
+        return [_node({"relu": "Relu", "sigmoid": "Sigmoid",
+                       "tanh": "Tanh", "exp": "Exp", "sqrt": "Sqrt",
+                       "abs": "Abs", "floor": "Floor", "ceil": "Ceil",
+                       "neg": "Neg", "identity": "Identity"}[t],
+                      ins[:1], outs)]
+    if t == "softmax":
+        return [_node("Softmax", ins[:1], outs,
+                      attrs={"axis": int(op.kwargs.get("axis", -1))})]
+    if t in ("add", "elementwise_add"):
+        return [_node("Add", ins[:2], outs)]
+    if t in ("multiply", "elementwise_mul"):
+        return [_node("Mul", ins[:2], outs)]
+    if t in ("subtract", "elementwise_sub"):
+        return [_node("Sub", ins[:2], outs)]
+    if t == "batch_norm":
+        # recorded order (x, mean, var, scale, bias) -> ONNX
+        # BatchNormalization inputs [X, scale, B, mean, var];
+        # inference form emits Y ONLY (the recorded new-mean/new-var
+        # outputs are training artifacts)
+        eps = float(op.kwargs.get("eps", 1e-5))
+        return [_node("BatchNormalization",
+                      [ins[0], ins[3], ins[4], ins[1], ins[2]],
+                      outs[:1], attrs={"epsilon": eps})]
     raise NotImplementedError(
-        "ONNX export requires paddle2onnx (unavailable offline). Use "
-        "paddle_tpu.jit.save (StableHLO/params) instead.")
+        f"onnx export: op type {t!r} has no ONNX lowering yet — "
+        "supported: conv2d, max/avg_pool2d, linear, matmul, flatten, "
+        "reshape, elementwise, activations, softmax, batch_norm")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a Layer to `path`+'.onnx' (reference export.py API).
+
+    input_spec: list of paddle.static.InputSpec-like (shape, dtype)
+    or example Tensors describing the inputs.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from .core.tensor import Tensor
+    from .static.graph import Variable
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export needs input_spec")
+    # snapshot params AND buffers: tracing writes traced values into
+    # running-stat buffers (BatchNorm), which would otherwise leak
+    # abstract values into the initializers
+    snapshot = []
+    for sub in (layer.sublayers(include_self=True)
+                if hasattr(layer, "sublayers") else [layer]):
+        for store in ("_parameters", "_buffers"):
+            for t in getattr(sub, store, {}).values():
+                if t is not None:
+                    snapshot.append((t, t._value))
+    was_static = paddle.in_static_mode() if hasattr(
+        paddle, "in_static_mode") else False
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                shape = list(getattr(spec, "shape", spec))
+                dtype = str(getattr(spec, "dtype", "float32"))
+                feeds.append(static.data(f"x{i}", shape, dtype))
+            out = layer(*feeds)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+    finally:
+        if not was_static:
+            paddle.disable_static()
+        for t, v in snapshot:
+            t._value = v
+
+    # name assignment
+    names = {}
+    counter = [0]
+
+    def new_name(prefix):
+        counter[0] += 1
+        return f"{prefix}_{counter[0]}"
+
+    initializers = []
+
+    def add_init(name, arr):
+        initializers.append(_tensor_proto(name, np.asarray(arr)))
+
+    def name_of(leaf):
+        if leaf is None:
+            return ""
+        if id(leaf) in names:
+            return names[id(leaf)]
+        if isinstance(leaf, Variable):
+            n = leaf.name or new_name("v")
+        elif isinstance(leaf, Tensor):
+            n = new_name("param")
+            add_init(n, np.asarray(leaf._value))
+        else:
+            n = new_name("const")
+            add_init(n, np.asarray(leaf))
+        names[id(leaf)] = n
+        return n
+
+    nodes = []
+    for op in main.global_block().ops:
+        in_names = [name_of(x) for x in op.in_leaves]
+        out_names = [name_of(v) for v in op.out_vars]
+        nodes.extend(_lower_op(
+            op,
+            {"in": in_names, "out": out_names,
+             "in_ranks": [len(getattr(x, "shape", []) or [])
+                          if x is not None else None
+                          for x in op.in_leaves],
+             "out_shapes": [list(v.shape) for v in op.out_vars]},
+            new_name, add_init))
+
+    graph = b"".join(_f_msg(1, n) for n in nodes)
+    graph += _f_str(2, getattr(layer, "__class__", type(layer)).__name__)
+    graph += b"".join(_f_msg(5, t) for t in initializers)
+    for i, f in enumerate(feeds):
+        graph += _f_msg(11, _value_info(
+            name_of(f), list(f.shape),
+            _DTYPES.get(str(f.dtype), 1)))
+    for o in outs:
+        graph += _f_msg(12, _value_info(name_of(o), list(o.shape)))
+
+    model = _f_int(1, 8)                       # ir_version
+    model += _f_str(2, "paddle_tpu")           # producer_name
+    model += _f_msg(7, graph)
+    model += _f_msg(8, _f_str(1, "") + _f_int(2, int(opset_version)))
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

@@ -126,8 +126,9 @@ def test_unsupported_constructs_fall_back():
             return x
         return x + 1
 
-    # return inside if -> fallback (None), caller uses trace-only
-    assert ast_transform(f_with_return) is None
+    # r4: return inside if CONVERTS now (return transformer)
+    g = ast_transform(f_with_return)
+    assert g is not None and g(7) == 7
 
     y = 3.0
 
@@ -892,3 +893,85 @@ def test_tensor_array_overflow_raises_eagerly():
     ta = ta.append(2.0)
     with pytest.raises(IndexError, match="capacity"):
         ta.append(3.0)
+
+
+# -- r4: return transformer (return_transformer.py parity) -------------------
+
+def test_early_return_concrete():
+    def f(x, flag):
+        if flag:
+            return x * 2
+        x = x + 1
+        return x
+
+    g = ast_transform(f)
+    assert g is not None
+    assert g(3, True) == f(3, True) == 6
+    assert g(3, False) == f(3, False) == 4
+
+
+def test_return_without_value_and_implicit_none():
+    def f(n):
+        for i in range(n):
+            if i == 2:
+                return
+        # implicit None either way
+
+    g = ast_transform(f)
+    assert g(5) is None and g(1) is None
+
+
+def test_return_inside_loop_exits_loop():
+    def f(n):
+        total = 0
+        for i in range(n):
+            total = total + i
+            if total > 5:
+                return total
+        return -total
+
+    g = ast_transform(f)
+    for n in (2, 10):
+        assert g(n) == f(n)
+
+
+def test_traced_early_return_selects():
+    """Early return on a TENSOR condition: both paths evaluate, the
+    predicate selects (the lax.cond-incompatible UNDEF slot is
+    zero-filled and guarded)."""
+    @to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x * 2.0
+        return x - 1.0
+
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(f(pos)._value), 2.0)
+    np.testing.assert_allclose(np.asarray(f(neg)._value), -2.0)
+
+
+def test_traced_return_trains():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+
+    @to_static
+    def step(x):
+        h = lin(x)
+        if paddle.mean(h) > 1000.0:  # never taken, but compiled
+            return (h * 0.0).sum()
+        return (h ** 2).mean()
+
+    opt = optim.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    losses = []
+    for _ in range(5):
+        loss = step(x)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
