@@ -28,10 +28,12 @@ CFG50 = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
 
 
 def _conv(x, w, stride=1):
+    # bf16 in/out (no preferred_element_type: an f32 primal output
+    # hands the conv transpose an f32 cotangent against bf16 operands,
+    # which lax rejects)
     return jax.lax.conv_general_dilated(
         x, w, (stride, stride), "SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 def _bn(x, scale, bias, training=True):

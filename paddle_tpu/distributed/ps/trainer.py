@@ -1,10 +1,11 @@
 """Trainer / DeviceWorker family — industrial training loops.
 
 Parity target: paddle/fluid/framework/trainer.h:101
-(TrainerBase/MultiTrainer/DistMultiTrainer) + device_worker.h
-(HogwildWorker, DownpourWorker) + trainer_desc.proto config: N worker
-threads consuming a dataset, asynchronously pulling/pushing sparse
-parameters against the PS.
+(TrainerBase/MultiTrainer/DistMultiTrainer/PipelineTrainer) +
+device_worker.h (HogwildWorker, DownpourWorker, SectionWorker:533 with
+the section_worker.cc:92-150 micro-batch loop) + trainer_desc.proto
+config: N worker threads consuming a dataset, asynchronously
+pulling/pushing sparse parameters against the PS.
 
 TPU-native framing: the DENSE model trains on-chip through the
 compiled step; the Trainer family exists for the CPU-side industrial
@@ -12,17 +13,23 @@ CTR workloads whose bulk is sparse-table traffic. HogwildTrainer runs
 lock-free multi-threaded workers (hogwild semantics: racy-but-
 convergent dense updates, per-thread PS pulls); DownpourTrainer adds
 the async PS communicator so grads push in the background —
-`DistMultiTrainer` + `DownpourWorker` in one object.
+`DistMultiTrainer` + `DownpourWorker` in one object; PipelineTrainer
+chains SectionWorker threads through bounded queues so micro-batches
+stream through the stage graph concurrently (the host-side
+section_worker.cc dataflow; the ON-CHIP pipeline schedule lives in
+distributed/pipeline.py as compiled collective-permutes).
 """
 from __future__ import annotations
 
+import queue
 import threading
 
 import numpy as np
 
 from . import AsyncCommunicator, PSClient
 
-__all__ = ["HogwildTrainer", "DownpourTrainer", "TrainerDesc"]
+__all__ = ["HogwildTrainer", "DownpourTrainer", "PipelineTrainer",
+           "SectionWorker", "TrainerDesc"]
 
 
 class TrainerDesc:
@@ -123,3 +130,94 @@ class DownpourTrainer(HogwildTrainer):
             if self.communicator is not None:
                 self.communicator.stop()
         return self
+
+
+class SectionWorker:
+    """One pipeline section (device_worker.h:533): consumes
+    micro-batches from its upstream queue, applies `section_fn`, and
+    pushes results downstream. `capacity` bounds the queue — the
+    credit-based flow control that keeps a fast producer from
+    flooding a slow consumer (section_worker.cc's sync queues)."""
+
+    _STOP = object()
+
+    def __init__(self, section_id, section_fn, capacity=2):
+        self.section_id = section_id
+        self.section_fn = section_fn
+        self.in_q = queue.Queue(maxsize=capacity)
+        self.out_q = None  # wired by the trainer
+        self._thread = None
+        self.errors = []
+        self.processed = 0
+
+    def _loop(self):
+        while True:
+            item = self.in_q.get()
+            if item is self._STOP:
+                if self.out_q is not None:
+                    self.out_q.put(self._STOP)
+                return
+            idx, payload = item
+            try:
+                out = self.section_fn(payload, self.section_id)
+            except Exception as e:  # surfaced at finalize
+                self.errors.append(e)
+                out = e
+            self.processed += 1
+            if self.out_q is not None:
+                self.out_q.put((idx, out))
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+
+class PipelineTrainer:
+    """Host-side pipeline trainer (trainer.h PipelineTrainer +
+    section_worker.cc:92-150 micro-batch loop): stage_fns[i] runs in
+    its own SectionWorker thread; micro-batches stream through the
+    chain so stage i works on micro-batch k while stage i+1 works on
+    k-1 — the F-then-B dataflow overlap, host edition.
+
+    run(batches) returns outputs IN ORDER (the trailing collector
+    reorders by index, though the bounded single-successor chain
+    already preserves order)."""
+
+    def __init__(self, stage_fns, capacity=2):
+        if not stage_fns:
+            raise ValueError("PipelineTrainer needs >= 1 stage")
+        self.workers = [SectionWorker(i, fn, capacity)
+                        for i, fn in enumerate(stage_fns)]
+        for up, down in zip(self.workers, self.workers[1:]):
+            up.out_q = down.in_q
+        self._final_q = queue.Queue()
+        self.workers[-1].out_q = self._final_q
+
+    def run(self, batches, timeout=None):
+        for w in self.workers:
+            w.start()
+        n = 0
+        for idx, b in enumerate(batches):
+            self.workers[0].in_q.put((idx, b))
+            n += 1
+        self.workers[0].in_q.put(SectionWorker._STOP)
+        outs = {}
+        while len(outs) < n:
+            item = self._final_q.get(timeout=timeout)
+            if item is SectionWorker._STOP:
+                break
+            idx, val = item
+            outs[idx] = val
+        for w in self.workers:
+            if not w.join(timeout):
+                raise RuntimeError(
+                    f"pipeline section {w.section_id} did not finish")
+        errs = [e for w in self.workers for e in w.errors]
+        if errs:
+            raise RuntimeError(
+                f"pipeline section failed: {errs[0]!r}") from errs[0]
+        return [outs[i] for i in range(n)]

@@ -1,0 +1,140 @@
+// Package paddle — Go inference API over the C ABI.
+//
+// Parity target: paddle/fluid/inference/goapi/ (the reference wraps
+// capi_exp with cgo exactly like this). The underlying C library
+// (../capi/pd_inference_api.{h,cc}) is built and tested in-tree
+// (tests/test_capi.py compiles and drives it); this package is the
+// thin cgo shim the reference ships.
+//
+// Build (after building libpd_inference, see ../capi/__init__.py):
+//
+//	CGO_CFLAGS="-I/path/to/paddle_tpu/inference/capi" \
+//	CGO_LDFLAGS="-L/path/to/build -lpd_inference" \
+//	go build ./...
+//
+// The Go toolchain is not present in the framework CI image, so this
+// file is validated structurally (tests/test_goapi.py checks every C
+// symbol it references exists in the tested C header) rather than
+// compiled there.
+package paddle
+
+/*
+#include <stdint.h>
+#include <stdlib.h>
+#include "pd_inference_api.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Init starts the embedded runtime (PD_Init). Call once per process.
+func Init() error {
+	if C.PD_Init() != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Finalize tears the runtime down (PD_Finalize).
+func Finalize() { C.PD_Finalize() }
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_GetLastError()))
+}
+
+// Config mirrors the reference goapi Config.
+type Config struct{ c *C.PD_Config }
+
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(c *Config) {
+		if c.c != nil {
+			C.PD_ConfigDestroy(c.c)
+		}
+	})
+	return cfg
+}
+
+// SetModel points at a jit.save / save_inference_model prefix.
+func (cfg *Config) SetModel(prefix string) {
+	cs := C.CString(prefix)
+	defer C.free(unsafe.Pointer(cs))
+	C.PD_ConfigSetModel(cfg.c, cs)
+}
+
+// SetOptimCacheDir sets the AOT executable cache directory.
+func (cfg *Config) SetOptimCacheDir(dir string) {
+	cs := C.CString(dir)
+	defer C.free(unsafe.Pointer(cs))
+	C.PD_ConfigSetOptimCacheDir(cfg.c, cs)
+}
+
+// Predictor mirrors the reference goapi Predictor.
+type Predictor struct{ p *C.PD_Predictor }
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil, lastError()
+	}
+	pred := &Predictor{p: p}
+	runtime.SetFinalizer(pred, func(pr *Predictor) {
+		if pr.p != nil {
+			C.PD_PredictorDestroy(pr.p)
+		}
+	})
+	return pred, nil
+}
+
+// GetInputNum returns the model's input arity.
+func (pred *Predictor) GetInputNum() int {
+	return int(C.PD_PredictorGetInputNum(pred.p))
+}
+
+// RunFloat feeds float32 inputs (data + shapes) and returns the first
+// output tensor's data and shape (PD_PredictorRunFloat).
+func (pred *Predictor) RunFloat(inputs [][]float32,
+	shapes [][]int64) ([]float32, []int64, error) {
+	n := len(inputs)
+	if n == 0 || n != len(shapes) {
+		return nil, nil, errors.New("inputs/shapes mismatch")
+	}
+	dataPtrs := make([]*C.float, n)
+	shapePtrs := make([]*C.int64_t, n)
+	ndims := make([]C.int, n)
+	for i := range inputs {
+		dataPtrs[i] = (*C.float)(unsafe.Pointer(&inputs[i][0]))
+		shapePtrs[i] = (*C.int64_t)(unsafe.Pointer(&shapes[i][0]))
+		ndims[i] = C.int(len(shapes[i]))
+	}
+	var outData *C.float
+	var outShape *C.int64_t
+	var outNdim C.int
+	rc := C.PD_PredictorRunFloat(pred.p,
+		(**C.float)(unsafe.Pointer(&dataPtrs[0])),
+		(**C.int64_t)(unsafe.Pointer(&shapePtrs[0])),
+		(*C.int)(unsafe.Pointer(&ndims[0])), C.int(n),
+		&outData, &outShape, &outNdim)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	defer C.PD_Free(unsafe.Pointer(outData))
+	defer C.PD_Free(unsafe.Pointer(outShape))
+	nd := int(outNdim)
+	shape := make([]int64, nd)
+	total := int64(1)
+	for i := 0; i < nd; i++ {
+		shape[i] = int64(*(*C.int64_t)(unsafe.Pointer(
+			uintptr(unsafe.Pointer(outShape)) +
+				uintptr(i)*unsafe.Sizeof(C.int64_t(0)))))
+		total *= shape[i]
+	}
+	out := make([]float32, total)
+	src := unsafe.Slice((*float32)(unsafe.Pointer(outData)), total)
+	copy(out, src)
+	return out, shape, nil
+}

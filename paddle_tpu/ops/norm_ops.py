@@ -65,22 +65,20 @@ def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
     reduce_axes = tuple(a for a in range(x.ndim) if a != channel_axis)
     if training:
         xf = x.astype(jnp.float32)
-        # shifted one-pass variance: E[(x-s)^2] - (E[x]-s)^2 with s =
-        # one sample per channel. Naive E[x^2]-E[x]^2 catastrophically
-        # cancels in f32 when |mean| >> std (e.g. un-normalized image
-        # input); shifting by any value near the data's magnitude makes
-        # both terms O(var), keeping the single fused read of x.
-        shift = jax.lax.stop_gradient(
-            jnp.mean(jax.lax.slice_in_dim(xf, 0, 1, axis=0),
-                     axis=reduce_axes))
-        sh = shift.reshape([1 if a != channel_axis else -1
-                            for a in range(x.ndim)])
-        xc = xf - sh
-        batch_mean_c = jnp.mean(xc, axis=reduce_axes)
-        batch_var = (jnp.mean(xc * xc, axis=reduce_axes)
-                     - batch_mean_c ** 2)
+        # plain E[x], E[x^2] stats. Round-3 shipped a "shifted
+        # one-pass" variant (subtract a per-channel sample before the
+        # moments) justified by a +9% probe — re-measured in r4 with
+        # TRUTHFUL syncs (see benchmarks/gemm_probe.py on the broken
+        # block_until_ready), the shift MATERIALIZES a full f32 copy
+        # of the activation (x - shift) whose forward+VJP traffic cost
+        # ~30% extra HBM bytes and ~20% ResNet-50 throughput. The
+        # numerically-risky |mean| >> std case (naive cancellation)
+        # is guarded by the f32 accumulate + clamp; BN inputs in
+        # practice are post-conv activations with O(1) magnitudes.
+        batch_mean = jnp.mean(xf, axis=reduce_axes)
+        batch_var = (jnp.mean(jnp.square(xf), axis=reduce_axes)
+                     - jnp.square(batch_mean))
         batch_var = jnp.maximum(batch_var, 0.0)
-        batch_mean = batch_mean_c + shift
         use_mean, use_var = batch_mean, batch_var
         n = x.size // x.shape[channel_axis]
         unbiased = batch_var * (n / max(n - 1, 1))

@@ -443,3 +443,72 @@ def test_downpour_train_from_dataset(cluster, tmp_path):
     # every touched row stepped by -lr per push it appeared in
     rows = c.pull_sparse("dft_emb", np.arange(50))
     assert (rows <= 0).all()
+
+
+def test_pipeline_trainer_sections_stream_and_match_serial():
+    """SectionWorker/PipelineTrainer (device_worker.h:533,
+    section_worker.cc:92-150): results equal the serial composition,
+    order preserved, and the stages actually OVERLAP (stage 0
+    processes micro-batch k while stage 1 is still on k-1)."""
+    import time
+
+    from paddle_tpu.distributed.ps.trainer import PipelineTrainer
+
+    overlap = {"max_inflight": 0, "inflight": 0}
+    lock = threading.Lock()
+
+    def make_stage(mult, delay):
+        def fn(x, sid):
+            with lock:
+                overlap["inflight"] += 1
+                overlap["max_inflight"] = max(overlap["max_inflight"],
+                                              overlap["inflight"])
+            time.sleep(delay)
+            with lock:
+                overlap["inflight"] -= 1
+            return x * mult
+
+        return fn
+
+    pt = PipelineTrainer([make_stage(2, 0.01), make_stage(3, 0.01),
+                          make_stage(5, 0.01)])
+    outs = pt.run(list(range(8)), timeout=30)
+    assert outs == [i * 30 for i in range(8)]
+    assert overlap["max_inflight"] >= 2  # stages ran concurrently
+
+
+def test_pipeline_trainer_surfaces_stage_errors():
+    from paddle_tpu.distributed.ps.trainer import PipelineTrainer
+
+    def bad(x, sid):
+        if x == 3:
+            raise ValueError("boom")
+        return x
+
+    pt = PipelineTrainer([bad])
+    with pytest.raises(RuntimeError, match="boom"):
+        pt.run(list(range(5)), timeout=30)
+
+
+def test_pipeline_trainer_with_ps_embedding(cluster):
+    """Industrial shape: stage 0 parses, stage 1 pulls PS rows, stage
+    2 reduces — micro-batches stream against the live PS."""
+    from paddle_tpu.distributed.ps.trainer import PipelineTrainer
+
+    _, c = cluster
+    c.create_sparse_table("pipe_emb", 4, initializer="zeros")
+    c.push_sparse("pipe_emb", np.arange(10),
+                  -np.ones((10, 4), np.float32), lr=1.0)
+
+    def parse(ids, sid):
+        return np.asarray(ids, np.int64)
+
+    def pull(ids, sid):
+        return c.pull_sparse("pipe_emb", ids)
+
+    def reduce_(rows, sid):
+        return float(rows.sum())
+
+    pt = PipelineTrainer([parse, pull, reduce_])
+    outs = pt.run([[1, 2], [3, 4, 5], [9]], timeout=30)
+    assert outs == [2 * 4.0, 3 * 4.0, 1 * 4.0]
