@@ -1,0 +1,92 @@
+"""GPT-2 345M config-sweep harness (r5 perf round).
+
+Runs the exact bench.py GPT-2 methodology (median-of-5 windows,
+.item() syncs) over a list of config variants passed on the CLI, so
+candidate optimizations are measured with the same instrument that
+records BENCH_r{N}.json.
+
+usage: python benchmarks/exp_gpt2.py '{"name":"ctl"}' \
+           '{"name":"u24","scan_unroll":24}' ...
+Each arg is a JSON dict: model-config overrides + optional "batch",
+"steps", "warmup", "accum".
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_variant(spec):
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    spec = dict(spec)
+    name = spec.pop("name")
+    batch = spec.pop("batch", 4)
+    steps = spec.pop("steps", 20)
+    warmup = spec.pop("warmup", 3)
+    windows = spec.pop("windows", 5)
+    accum = spec.pop("accum", 1)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=16, ffn_hidden=4096, max_seq_len=1024,
+                    dropout=0.0, remat=False, use_flash_attention=True,
+                    **spec)
+    seq = 1024
+    model = GPTForCausalLM(cfg)
+    model = amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = optim.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      weight_decay=0.01, multi_precision=True)
+    step = TrainStepCompiler(model, opt, loss_fn=None,
+                             accumulate_steps=accum)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                          (batch, seq)).astype(np.int32))
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    first = float(loss.item())
+    compile_s = time.perf_counter() - t0
+    dts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids, labels)
+        last = float(loss.item())
+        dts.append((time.perf_counter() - t0) / steps)
+    dt = float(np.median(dts))
+    assert np.isfinite(last) and last < first, (name, first, last)
+    toks = batch * seq / dt
+    n = sum(int(np.prod(p.shape)) for p in model.parameters())
+    mfu = 6 * n * batch * seq / dt / 197e12
+    rec = {"name": name, "tok_s": round(toks, 1),
+           "ms_step": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+           "compile_s": round(compile_s, 1),
+           "spread_ms": [round(d * 1e3, 2) for d in dts]}
+    print("[exp]", json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    recs = []
+    for arg in sys.argv[1:]:
+        spec = json.loads(arg)
+        try:
+            recs.append(run_variant(spec))
+        except Exception as e:
+            print("[exp]", json.dumps({"name": spec.get("name"),
+                                       "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+    print(json.dumps(recs))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,30 @@ import numpy as np
 __all__ = ["DataPlane"]
 
 
+def _advertised_host():
+    """Host other ranks should dial for THIS rank's data plane.
+
+    r4 advisor: publishing a hard-coded 127.0.0.1 under dp/{rank}
+    breaks multi-host runs even though the store rendezvous works
+    cross-host. Resolution order: explicit PADDLE_DATAPLANE_HOST, then
+    the host part of the launcher's PADDLE_CURRENT_ENDPOINT (reference
+    env contract: gen_comm_id_helper derives the NCCL socket ifname
+    from the trainer endpoint), else loopback for single-host runs."""
+    import os
+
+    host = os.environ.get("PADDLE_DATAPLANE_HOST")
+    if host:
+        return host
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    if ":" in ep:
+        h = ep.rsplit(":", 1)[0]
+        # wildcard listen addresses are not dialable — publishing them
+        # would make peers connect to their own loopback
+        if h and h not in ("localhost", "0.0.0.0", "::", "[::]"):
+            return h
+    return "127.0.0.1"
+
+
 def _send_frame(sock_file, obj):
     payload = pickle.dumps(obj, protocol=5)
     sock_file.write(struct.pack("<Q", len(payload)) + payload)
@@ -48,30 +72,45 @@ def _recv_frame(sock_file):
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         dp = self.server.dataplane
-        while True:
-            try:
-                frame = _recv_frame(self.rfile)
-            except (ConnectionError, EOFError, OSError):
-                return
-            dp._deliver(frame)
+        dp._track_inbound(self.connection, add=True)
+        try:
+            while True:
+                try:
+                    frame = _recv_frame(self.rfile)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                dp._deliver(frame)
+        finally:
+            dp._track_inbound(self.connection, add=False)
 
 
 class DataPlane:
     """One per process: a listener for inbound tensors + cached
     outbound connections."""
 
-    def __init__(self, host="127.0.0.1", port=0):
+    def __init__(self, host=None, port=0):
         class Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Srv((host, port), _Handler)
+        if host is None:
+            host = _advertised_host()
+        # advertising a routable address requires listening beyond
+        # loopback; bind the wildcard in that case so cross-host peers
+        # can actually connect to the endpoint we publish
+        bind_host = "0.0.0.0" if host != "127.0.0.1" else host
+        self._server = Srv((bind_host, port), _Handler)
         self._server.dataplane = self
-        self.host, self.port = self._server.server_address
+        self.host = host
+        self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
         self._inbox = {}          # (src, tag) -> {seq: ndarray}
+        self._inbound = set()     # live inbound sockets (closed on
+        self._inbound_lock = threading.Lock()  # close(), like a real
+        # process restart would — otherwise daemon handler threads keep
+        # absorbing frames addressed to a successor on the same port
         self._cv = threading.Condition()
         self._conns = {}          # endpoint -> socket file
         self._conn_locks = {}     # endpoint -> lock
@@ -107,43 +146,84 @@ class DataPlane:
             return arr.copy()  # frombuffer views the frame; detach
 
     # -- send side ------------------------------------------------------
-    def _conn(self, endpoint):
+    def _lock_for(self, endpoint):
         with self._glock:
-            lock = self._conn_locks.setdefault(endpoint,
+            return self._conn_locks.setdefault(endpoint,
                                                threading.Lock())
-        with lock:
-            f = self._conns.get(endpoint)
-            if f is None:
-                host, port = endpoint.rsplit(":", 1)
-                s = socket.create_connection((host, int(port)))
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                f = s.makefile("wb")
-                self._conns[endpoint] = f
-        return lock, f
+
+    def _dial_locked(self, endpoint):
+        """Get-or-dial the cached connection. Caller MUST hold the
+        per-endpoint lock — this method takes no locks itself, so the
+        send() retry path can redial under the lock it already holds
+        (r4 advisor: the old _conn re-acquired the same non-reentrant
+        lock from inside send's except block and deadlocked)."""
+        ent = self._conns.get(endpoint)
+        if ent is None:
+            host, port = endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)))
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            ent = (s, s.makefile("wb"))
+            self._conns[endpoint] = ent
+        return ent
+
+    def _drop_locked(self, endpoint, ent):
+        self._conns.pop(endpoint, None)
+        for obj in ent[::-1]:
+            try:
+                obj.close()
+            except OSError:
+                pass
 
     def send(self, endpoint, src, tag, seq, arr, timeout=180.0):
         arr = np.ascontiguousarray(arr)
-        lock, f = self._conn(endpoint)
         frame = {"src": int(src), "tag": tag, "seq": int(seq),
                  "dt": str(arr.dtype), "sh": list(arr.shape),
                  "data": arr.tobytes()}
+        lock = self._lock_for(endpoint)
         with lock:
+            ent = self._conns.get(endpoint)
+            if ent is not None:
+                # peers never write back on a data connection, so
+                # readability means EOF/RST: the receiver restarted.
+                # Without this probe the first write after a restart
+                # "succeeds" into the kernel buffer and the frame is
+                # silently lost (TCP reports the RST on the NEXT write).
+                import select as _select
+
+                r, _, _ = _select.select([ent[0]], [], [], 0)
+                if r:
+                    self._drop_locked(endpoint, ent)
+            ent = self._dial_locked(endpoint)
             try:
-                _send_frame(f, frame)
+                _send_frame(ent[1], frame)
             except (OSError, ConnectionError):
                 # reconnect once (receiver may have restarted)
-                with self._glock:
-                    self._conns.pop(endpoint, None)
-                lock2, f2 = self._conn(endpoint)
-                _send_frame(f2, frame)
+                self._drop_locked(endpoint, ent)
+                ent2 = self._dial_locked(endpoint)
+                _send_frame(ent2[1], frame)
         self.sends += 1
+
+    def _track_inbound(self, conn, add):
+        with self._inbound_lock:
+            if add:
+                self._inbound.add(conn)
+            else:
+                self._inbound.discard(conn)
 
     def close(self):
         self._server.shutdown()
         self._server.server_close()
-        for f in self._conns.values():
-            try:
-                f.close()
-            except OSError:
-                pass
+        with self._inbound_lock:
+            for c in list(self._inbound):
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            self._inbound.clear()
+        for ent in self._conns.values():
+            for obj in ent[::-1]:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
         self._conns.clear()

@@ -240,6 +240,37 @@ def convert_ifelse(pred, true_fn, false_fn, names=()):
                 if t_un and f_un:
                     outs.append(tv)  # never assigned on either path;
                     continue         # stays UNDEF (loud if read)
+                if str(n).startswith("__jst_rf"):
+                    # return-flag merge: alongside the runtime select,
+                    # compute the trace-time verdict "can this flag be
+                    # False on some path" so finalize_ret can reject
+                    # fall-through instead of returning the zero-filled
+                    # rv placeholder (r4 advisor). The transform's own
+                    # tail guard `if not rf: <tail>` is recognized by
+                    # pred == not(false-branch flag): on that guard's
+                    # false path the flag is True by construction, so
+                    # only the tail's verdict counts.
+                    if (not _is_traced(tv) and not t_un
+                            and not _rf_may_be_false(tv)
+                            and not _is_traced(fv) and not f_un
+                            and not _rf_may_be_false(fv)):
+                        outs.append(True)  # both paths returned: stay
+                        continue           # concrete (Python semantics)
+                    if getattr(pred, "_jst_not_of", None) is fv:
+                        may_false = _rf_may_be_false(tv)
+                    elif getattr(pred, "_jst_not_of", None) is tv:
+                        may_false = _rf_may_be_false(fv)
+                    else:
+                        may_false = (_rf_may_be_false(tv)
+                                     or _rf_may_be_false(fv))
+                    tj = (jnp.zeros((), bool) if t_un
+                          else jnp.asarray(_unwrap(tv)).astype(bool))
+                    fj = (jnp.zeros((), bool) if f_un
+                          else jnp.asarray(_unwrap(fv)).astype(bool))
+                    merged = _wrap(jnp.where(pv, tj, fj))
+                    merged.__dict__["_jst_rf_may_be_false"] = may_false
+                    outs.append(merged)
+                    continue
                 if t_un or f_un:
                     if not str(n).startswith("__jst_rv"):
                         raise ValueError(
@@ -360,11 +391,27 @@ def _traced_while(cond_fn, body_fn, init_vals):
     return tuple(_from_jax_tree(o) for o in outs)
 
 
+def _rf_may_be_false(v):
+    """Abstract truth of a return flag at trace time: False means the
+    flag is provably True on every traced path. Concrete flags answer
+    directly; traced flags carry the verdict computed at their
+    convert_ifelse merge (absent -> conservatively may-be-false, e.g.
+    a flag threaded through a traced loop carry)."""
+    if isinstance(v, _Undefined):
+        return True
+    if _is_traced(v):
+        return getattr(v, "_jst_rf_may_be_false", True)
+    return not _truthy(_unwrap(v))
+
+
 def finalize_ret(rf, rv):
     """Function-tail return selector (return_transformer analog): flag
     concrete -> Python semantics exactly (None when no return ran);
-    flag traced -> the function returned on every traced path (the
-    transform guarantees rv is bound there)."""
+    flag traced -> the function must have returned on every traced
+    path. rv being bound is NOT sufficient evidence of that: the
+    one-sided-return select in convert_ifelse zero-fills the missing
+    side (r4 advisor: f with `if c: return x*2` and no tail silently
+    returned zeros), so the flag's own may-be-false verdict decides."""
     if isinstance(rv, _Undefined):
         if _is_traced(rf):
             raise ValueError(
@@ -373,7 +420,15 @@ def finalize_ret(rf, rv):
                 "must return a value on every path (Python's implicit "
                 "None has no tensor representation)")
         return None
-    if not _is_traced(rf) and not _truthy(_unwrap(rf)):
+    if _is_traced(rf):
+        if _rf_may_be_false(rf):
+            raise ValueError(
+                "dy2static: a traced-condition path reaches the end of "
+                "the function without returning — traced functions "
+                "must return a value on every path (Python's implicit "
+                "None has no tensor representation)")
+        return rv
+    if not _truthy(_unwrap(rf)):
         return None
     return rv
 
@@ -428,8 +483,14 @@ def convert_logical_or(x, y_fn):
 
 def convert_logical_not(x):
     if _is_traced(x):
-        return _wrap(jnp.logical_not(
+        out = _wrap(jnp.logical_not(
             jnp.asarray(_unwrap(x)).astype(bool)))
+        # remember the operand: the return-guard pattern the transform
+        # emits (`if not __jst_rf_0: <tail>`) is recognized in
+        # convert_ifelse by the pred's operand being identical to the
+        # false branch's flag value (see _rf_may_be_false)
+        out.__dict__["_jst_not_of"] = x
+        return out
     return not _truthy(_unwrap(x))
 
 

@@ -363,9 +363,21 @@ def add(x, y, name=None):
 
 
 def subtract(x, y, name=None):
-    out = _binary_union(x, y, -1)
-    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
-        else out
+    sp_x = isinstance(x, (SparseCooTensor, SparseCsrTensor))
+    sp_y = isinstance(y, (SparseCooTensor, SparseCsrTensor))
+    if sp_x and sp_y:
+        out = _binary_union(x, y, -1)
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+            else out
+    # mixed sparse/dense (r4 advisor: this used to fall into
+    # _binary_union and die on .indices_t): express via add with the
+    # negated other operand — the result is dense either way
+    from ..ops import math as _math
+
+    if sp_x:
+        return add(x, _math.scale(
+            y if isinstance(y, Tensor) else _as_tensor(y), -1.0))
+    return add(scale(y, -1.0), x)
 
 
 def multiply(x, y, name=None):
@@ -373,6 +385,10 @@ def multiply(x, y, name=None):
     scaled by the dense entries at the coordinates)."""
     if isinstance(y, (int, float)):
         return scale(x, float(y))
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        x, y = y, x  # dense * sparse commutes (pattern follows sparse)
+        if isinstance(y, (int, float)):  # scalar was the LEFT operand
+            return scale(x, float(y))
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         # same-shape product: zeros anywhere kill the entry, so
         # multiplying by the other side's dense form is exact
@@ -393,6 +409,12 @@ def multiply(x, y, name=None):
 def divide(x, y, name=None):
     if isinstance(y, (int, float)):
         return scale(x, 1.0 / float(y))
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError(
+            "sparse.divide: the dividend must be sparse — dense / "
+            "sparse would divide by the sparse operand's implicit "
+            "zeros almost everywhere; densify explicitly (y.to_dense())"
+            " if that is really intended")
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         y = y.to_dense()
     xc = _coo_of(x)

@@ -249,11 +249,18 @@ def _k_gpt_loss(ids, labels, params, n_head, eps, use_flash, remat,
                             dropout, key, pp_stages, pp_microbatches,
                             use_ring, pp_schedule, remat_policy,
                             scan_unroll)
-    lsm = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    # CE as logsumexp - gathered logit: identical math to
+    # log_softmax+gather but never materializes the [B,S,V] f32
+    # log-probs array — the f32 convert fuses into the two reduction
+    # passes and the gather, cutting ~2 GB of HBM traffic per step at
+    # the bench config (r5 perf round, profile showed 28.7% of the
+    # step in top-level elementwise fusions)
+    lg = logits[:, :-1].astype(jnp.float32)
     tgt = labels[:, 1:]
-    picked = jnp.take_along_axis(lsm, tgt[..., None].astype(jnp.int32),
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    picked = jnp.take_along_axis(lg, tgt[..., None].astype(jnp.int32),
                                  axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    return jnp.mean(lse - picked)
 
 
 class GPTModel(Layer):

@@ -1,0 +1,134 @@
+"""Regression tests for the r4 advisor findings (ADVICE.md round 4)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- dataplane: reconnect must not deadlock (advisor medium #1) ----------
+
+def test_dataplane_reconnect_after_receiver_restart():
+    from paddle_tpu.distributed.dataplane import DataPlane
+
+    rx = DataPlane(host="127.0.0.1")
+    tx = DataPlane(host="127.0.0.1")
+    arr = np.arange(8, dtype=np.float32)
+    tx.send(rx.endpoint, src=1, tag="t", seq=0, arr=arr)
+    got = rx.recv(src=1, tag="t", seq=0, timeout=10)
+    np.testing.assert_array_equal(got, arr)
+
+    # receiver "restarts": old server goes away, a new one takes the
+    # same port; the sender's cached connection is now dead
+    port = rx.port
+    rx.close()
+    rx2 = DataPlane(host="127.0.0.1", port=port)
+
+    done = {}
+
+    def _send():
+        tx.send(rx2.endpoint, src=1, tag="t", seq=1, arr=arr * 2)
+        done["ok"] = True
+
+    th = threading.Thread(target=_send, daemon=True)
+    th.start()
+    th.join(timeout=15)  # the old code deadlocked here forever
+    assert done.get("ok"), "send deadlocked in the reconnect path"
+    got = rx2.recv(src=1, tag="t", seq=1, timeout=10)
+    np.testing.assert_array_equal(got, arr * 2)
+    tx.close()
+    rx2.close()
+
+
+def test_dataplane_advertised_host_from_env(monkeypatch):
+    from paddle_tpu.distributed.dataplane import _advertised_host
+
+    monkeypatch.delenv("PADDLE_DATAPLANE_HOST", raising=False)
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "10.1.2.3:6170")
+    assert _advertised_host() == "10.1.2.3"
+    monkeypatch.setenv("PADDLE_DATAPLANE_HOST", "10.9.9.9")
+    assert _advertised_host() == "10.9.9.9"
+    monkeypatch.delenv("PADDLE_DATAPLANE_HOST", raising=False)
+    monkeypatch.delenv("PADDLE_CURRENT_ENDPOINT", raising=False)
+    assert _advertised_host() == "127.0.0.1"
+
+
+# -- dy2static: one-sided traced return must raise (advisor medium #2) ---
+
+def test_one_sided_return_raises(tmp_path):
+    from paddle_tpu.jit import to_static
+
+    src = tmp_path / "mod_onesided.py"
+    src.write_text(
+        "import paddle_tpu as paddle\n"
+        "def one_sided(x):\n"
+        "    if paddle.mean(x) > 0:\n"
+        "        return x * 2\n"
+        "def tail_ret(x):\n"
+        "    if paddle.mean(x) > 0:\n"
+        "        return x * 2\n"
+        "    return x * 3\n"
+        "def nested_tail(x):\n"
+        "    if paddle.mean(x) > 0:\n"
+        "        if paddle.max(x) > 5:\n"
+        "            return x * 4\n"
+        "        return x * 2\n"
+        "    return x * 3\n")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("mod_onesided", src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    big = paddle.to_tensor(np.full(3, 10.0, np.float32))
+
+    with pytest.raises(ValueError, match="every path"):
+        to_static(mod.one_sided)(neg)
+    # legit early-return patterns keep working
+    np.testing.assert_allclose(to_static(mod.tail_ret)(pos).numpy(),
+                               2 * np.ones(3))
+    np.testing.assert_allclose(to_static(mod.tail_ret)(neg).numpy(),
+                               -3 * np.ones(3))
+    f = to_static(mod.nested_tail)
+    np.testing.assert_allclose(f(big).numpy(), 40 * np.ones(3))
+    np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(3))
+    np.testing.assert_allclose(f(neg).numpy(), -3 * np.ones(3))
+
+
+# -- sparse: mixed sparse/dense binary ops (advisor low #4) --------------
+
+def test_sparse_subtract_mixed_dense():
+    import paddle_tpu.sparse as sparse
+
+    dense = np.array([[0.0, 1.0], [2.0, 0.0]], np.float32)
+    sp = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    other = np.array([[1.0, 1.0], [1.0, 1.0]], np.float32)
+    ot = paddle.to_tensor(other)
+
+    out = sparse.subtract(sp, ot)
+    np.testing.assert_allclose(out.numpy(), dense - other)
+    out2 = sparse.subtract(ot, sp)
+    np.testing.assert_allclose(out2.numpy(), other - dense)
+
+
+def test_sparse_multiply_dense_lhs():
+    import paddle_tpu.sparse as sparse
+
+    dense = np.array([[0.0, 2.0], [3.0, 0.0]], np.float32)
+    sp = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    other = np.array([[5.0, 6.0], [7.0, 8.0]], np.float32)
+
+    out = sparse.multiply(paddle.to_tensor(other), sp)
+    np.testing.assert_allclose(out.to_dense().numpy(), dense * other)
+
+
+def test_sparse_divide_dense_lhs_raises():
+    import paddle_tpu.sparse as sparse
+
+    dense = np.array([[0.0, 2.0], [3.0, 0.0]], np.float32)
+    sp = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    with pytest.raises(TypeError, match="dividend must be sparse"):
+        sparse.divide(paddle.to_tensor(np.ones((2, 2), np.float32)), sp)
