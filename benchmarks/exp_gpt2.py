@@ -34,10 +34,11 @@ def run_variant(spec):
     windows = spec.pop("windows", 5)
     accum = spec.pop("accum", 1)
     paddle.seed(0)
-    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                    num_heads=16, ffn_hidden=4096, max_seq_len=1024,
-                    dropout=0.0, remat=False, use_flash_attention=True,
-                    **spec)
+    base = dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+                num_heads=16, ffn_hidden=4096, max_seq_len=1024,
+                dropout=0.0, remat=False, use_flash_attention=True)
+    base.update(spec)
+    cfg = GPTConfig(**base)
     seq = 1024
     model = GPTForCausalLM(cfg)
     model = amp.decorate(model, level="O2", dtype="bfloat16")

@@ -51,7 +51,7 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024,
                         num_layers=24, num_heads=16, ffn_hidden=4096,
                         max_seq_len=1024, dropout=0.0, remat=False,
-                        use_flash_attention=True)
+                        use_flash_attention=True, scan_unroll=24)
         batch, seq = 4, 1024
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
@@ -73,7 +73,7 @@ def main():
                                           (batch, seq)).astype(np.int32))
     step(ids, labels).item()  # compile outside the trace
 
-    prof = profiler.Profiler()
+    prof = profiler.Profiler(python_tracer=False)
     prof.start()
     for _ in range(args.steps):
         with profiler.RecordEvent("train_step"):

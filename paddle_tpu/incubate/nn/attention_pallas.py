@@ -26,8 +26,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# r5 on-chip sweep (benchmarks/attn_bench.py, B=4 H=16 S=1024 D=64,
+# fwd+bwd): (1024,1024) 1.22 ms beats (256,256) 2.50 ms, (512,512)
+# 3.40 ms, jax's reference TPU pallas kernel 4.48 ms and XLA dense
+# 8.25 ms — per-grid-step overhead dominates KV streaming at these
+# sizes, so prefer the largest block that fits VMEM (the [bq,bk] f32
+# score tile is the biggest buffer: 1024^2*4 = 4 MB of ~16 MB).
+# _pick_block still drops to divisors of shorter sequences, and long
+# sequences tile at 1024 with the causal block skip.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 # per-row stats (lse/delta) ride a trailing lane dim; 8 satisfies the
 # TPU tiling rule (block last dim == full array dim) at 16x less HBM

@@ -280,8 +280,46 @@ def _np_collate(batch):
     return batch
 
 
+def _detach_views(obj):
+    """Copy numpy arrays that don't own their data (shm-slot views) so
+    the caller owns the batch outright. Exact tuple/list/dict recurse
+    cheaply; any other container (namedtuple, dataclass, subclass)
+    deep-copies — deepcopy preserves the type AND detaches every array
+    view, matching the ownership the old pickle round-trip gave."""
+    if isinstance(obj, np.ndarray):
+        return obj.copy() if obj.base is not None else obj
+    if type(obj) is tuple:
+        return tuple(_detach_views(o) for o in obj)
+    if type(obj) is list:
+        return [_detach_views(o) for o in obj]
+    if type(obj) is dict:
+        return {k: _detach_views(v) for k, v in obj.items()}
+    if isinstance(obj, (int, float, complex, str, bytes, bool,
+                        type(None))):
+        return obj
+    import copy
+
+    return copy.deepcopy(obj)
+
+
+_cpu_backend = None
+
+
 def _to_device(obj):
     if isinstance(obj, np.ndarray):
+        # jax's CPU client zero-copies 64B-aligned numpy arrays into
+        # device buffers — a shm-ring slot view would then alias the
+        # ring past slot reuse/munmap (verified: mutating the backing
+        # buffer changes the "device" array). Detach views on the CPU
+        # backend; an accelerator device_put always copies off-host.
+        global _cpu_backend
+        if obj.base is not None:
+            if _cpu_backend is None:
+                import jax
+
+                _cpu_backend = jax.default_backend() == "cpu"
+            if _cpu_backend:
+                obj = obj.copy()
         return to_tensor(obj)
     if isinstance(obj, tuple):
         return tuple(_to_device(o) for o in obj)
@@ -383,7 +421,8 @@ class DataLoader:
                 self.persistent_workers,
                 iterable_mode=self._iterable_mode,
                 batch_size=self.batch_size,
-                drop_last=self.drop_last)
+                drop_last=self.drop_last,
+                default_collate=self.collate_fn is None)
 
         try:
             if self.persistent_workers:
@@ -420,7 +459,14 @@ class DataLoader:
         raw = self.collate_fn is not None
         try:
             for batch in loader.run_epoch(batches):
-                yield batch if raw else _to_device(batch)
+                # zero-copy batches alias the shm ring slot, valid only
+                # until that worker's next batch is fetched. The
+                # default path's _to_device copies host->device before
+                # the user sees the batch; raw mode (custom collate_fn)
+                # hands out numpy arrays, so detach slot-aliasing ones
+                # with one memcpy (still 3 copies cheaper than the old
+                # pickle+ring+unpickle transport).
+                yield _detach_views(batch) if raw else _to_device(batch)
         finally:
             if owned:
                 loader.shutdown()

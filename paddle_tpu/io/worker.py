@@ -20,12 +20,161 @@ import ctypes
 import os
 import pickle
 import queue
+import sys as _sys
 import threading
 
 import numpy as np
 
 _EOF = b"\x00PDEOF"
 _ERR = b"\x00PDERR"
+
+# zero-copy frame: magic(8) meta_len(8) nbufs(8) [off(8) len(8)]*n
+# meta-pickle then 64B-aligned out-of-band buffers. Arrays deserialize
+# ALIASING the shm slot — the slot is held until the next pop on the
+# same ring, and the trainer's _to_device does the single remaining
+# copy (host->device).
+_ZC_MAGIC = b"PDZC\x01\x00\x00\x00"
+
+
+def _zero_copy_enabled():
+    return os.environ.get("FLAGS_dataloader_zero_copy", "1") != "0"
+
+
+def _slot_overflow(nbytes, slot_bytes):
+    return ValueError(
+        f"batch of {nbytes} bytes exceeds the shared-memory slot "
+        f"({slot_bytes}B) — raise FLAGS_dataloader_shm_slot_mb or "
+        "shrink the batch")
+
+
+def _push_batch(ring, batch):
+    """Serialize a batch into `ring`. Zero-copy framing when enabled:
+    pickle protocol-5 splits numpy array bodies out as buffers, and
+    both the metadata and the buffers are written DIRECTLY into the
+    reserved shm slot (no intermediate bytes object, no second copy in
+    ring_push)."""
+    import struct
+
+    if not _zero_copy_enabled():
+        ring.push(pickle.dumps(batch, protocol=5))
+        return
+    bufs = []
+    meta = pickle.dumps(batch, protocol=5, buffer_callback=bufs.append)
+    raws = [b.raw().cast("B") for b in bufs]
+    n = len(raws)
+    header = 24 + n * 16
+    off = header + len(meta)
+    table = []
+    for r in raws:
+        off = (off + 63) & ~63          # 64B-align each array body
+        table.append((off, r.nbytes))
+        off += r.nbytes
+    total = off
+    if total > ring.slot_bytes:
+        raise _slot_overflow(total, ring.slot_bytes)
+    mv = ring.reserve()
+    struct.pack_into("<8sQQ", mv, 0, _ZC_MAGIC, len(meta), n)
+    for i, (o, ln) in enumerate(table):
+        struct.pack_into("<QQ", mv, 24 + i * 16, o, ln)
+    mv[header:header + len(meta)] = meta
+    for (o, ln), r in zip(table, raws):
+        mv[o:o + ln] = r
+    mv.release()
+    ring.commit(total)
+
+
+# the stacked fast path writes array bodies at fixed offsets after a
+# reserved header page, so collation happens DIRECTLY into the slot
+# (one copy per sample total: sample -> shm; the separate np.stack
+# batch materialization disappears)
+_ZC_HEADER_BYTES = 4096
+
+
+def _try_push_stacked(ring, samples):
+    """Collate-into-slot fast path for the default collate: samples
+    that are flat tuples/lists of array-likes with identical structure
+    stack straight into the reserved shm slot. Returns False when the
+    structure is unsupported (caller falls back to collate+push)."""
+    import struct
+
+    first = samples[0]
+    if not isinstance(first, (tuple, list)):
+        return False
+    k = len(first)
+    try:
+        arrs0 = [np.asarray(f) for f in first]
+    except Exception:
+        return False
+    if any(a.dtype == object for a in arrs0):
+        return False
+    B = len(samples)
+    off = _ZC_HEADER_BYTES
+    layout = []
+    for a in arrs0:
+        off = (off + 63) & ~63
+        nbytes = int(a.nbytes) * B
+        layout.append((off, (B,) + a.shape, a.dtype))
+        off += nbytes
+    total = off
+    if total > ring.slot_bytes:
+        raise _slot_overflow(total, ring.slot_bytes)
+    mv = ring.reserve()
+    views = batch = bufs = None
+    try:
+        views = []
+        for o, shape, dtype in layout:
+            v = np.frombuffer(mv, dtype=dtype,
+                              count=int(np.prod(shape)),
+                              offset=o).reshape(shape)
+            views.append(v)
+        for j, s in enumerate(samples):
+            if len(s) != k:
+                return False
+            for i in range(k):
+                np.copyto(views[i][j], np.asarray(s[i]),
+                          casting="same_kind")
+        # meta: pickle the slot-aliasing arrays out-of-band — the
+        # buffer table then points at the bodies already in the slot
+        bufs = []
+        batch = tuple(views)
+        meta = pickle.dumps(batch, protocol=5,
+                            buffer_callback=bufs.append)
+        n = len(bufs)
+        header = 24 + n * 16
+        if header + len(meta) > _ZC_HEADER_BYTES or n != k:
+            return False  # generic path re-reserves the same slot
+        struct.pack_into("<8sQQ", mv, 0, _ZC_MAGIC, len(meta), n)
+        for i, (o, shape, dtype) in enumerate(layout):
+            nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            struct.pack_into("<QQ", mv, 24 + i * 16, o, nb)
+        mv[header:header + len(meta)] = meta
+    except (TypeError, ValueError):
+        return False  # dtype/casting surprise: let collate+push handle
+    finally:
+        # drop every slot-aliasing export (arrays, PickleBuffers)
+        # before releasing the memoryview — release() raises
+        # BufferError while exports are alive
+        views = batch = bufs = None
+        mv.release()
+    ring.commit(total)
+    return True
+
+
+def _decode_view(view):
+    """Deserialize a zero-copy framed batch from a slot view, or None
+    if the payload is not zero-copy framed (markers, plain pickles).
+    The returned object's arrays alias `view`'s memory."""
+    import struct
+
+    if len(view) < 24 or bytes(view[:8]) != _ZC_MAGIC:
+        return None
+    _, meta_len, n = struct.unpack_from("<8sQQ", view, 0)
+    header = 24 + n * 16
+    table = [struct.unpack_from("<QQ", view, 24 + i * 16)
+             for i in range(n)]
+    meta = view[header:header + meta_len]
+    bufs = [view[o:o + ln] for (o, ln) in table]
+    return pickle.loads(meta, buffers=bufs)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -50,6 +199,17 @@ def _ring_lib():
             lib.ring_pop.restype = ctypes.c_int64
             lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_uint64, ctypes.c_int64]
+            lib.ring_push_reserve.restype = ctypes.c_void_p
+            lib.ring_push_reserve.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+            lib.ring_push_commit.restype = ctypes.c_int
+            lib.ring_push_commit.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64]
+            lib.ring_pop_view.restype = ctypes.c_void_p
+            lib.ring_pop_view.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_uint64),
+                                          ctypes.c_int64]
+            lib.ring_pop_release.argtypes = [ctypes.c_void_p]
             lib.ring_close.argtypes = [ctypes.c_void_p]
             lib.ring_unlink.argtypes = [ctypes.c_char_p]
             _lib = lib
@@ -68,27 +228,59 @@ class ShmRing:
         if not self._h:
             raise OSError(f"shm ring {name} open failed")
         self._creator = create
-        self._buf = None  # lazy: workers only push; don't hold 64MB
+        self._pending = False
+        # bind ctypes helpers: module globals are None'd during
+        # interpreter shutdown while generator finalizers may still
+        # drain rings
+        self._c_uint64 = ctypes.c_uint64
+        self._byref = ctypes.byref
+        self._c_ubyte = ctypes.c_ubyte
 
     def push(self, data: bytes, timeout_ms=-1):
         rc = self._lib.ring_push(self._h, data, len(data), timeout_ms)
         if rc == -2:
-            raise ValueError(
-                f"batch of {len(data)} bytes exceeds the shared-memory "
-                f"slot ({self.slot_bytes}B) — raise "
-                "FLAGS_dataloader_shm_slot_mb or shrink the batch")
+            raise _slot_overflow(len(data), self.slot_bytes)
         return rc == 0
 
-    def pop(self, timeout_ms=-1):
-        if self._buf is None:
-            self._buf = ctypes.create_string_buffer(self.slot_bytes)
-        n = self._lib.ring_pop(self._h, self._buf, self.slot_bytes,
-                               timeout_ms)
-        if n == -1:
+    # -- zero-copy API (r5): batches serialize straight into the slot
+    # and deserialize straight out of it; see _push_batch/_decode_view
+    def reserve(self, timeout_ms=-1):
+        """Writable memoryview over the next free slot's payload area
+        (full slot_bytes), or None on timeout. Publish with commit()."""
+        if not self._h:
             return None
-        if n < 0:
-            raise OSError(f"ring_pop error {n}")
-        return self._buf.raw[:n]
+        ptr = self._lib.ring_push_reserve(self._h, timeout_ms)
+        if not ptr:
+            return None
+        arr = (self._c_ubyte * self.slot_bytes).from_address(ptr)
+        return memoryview(arr).cast("B")
+
+    def commit(self, length):
+        rc = self._lib.ring_push_commit(self._h, length)
+        if rc == -2:
+            raise _slot_overflow(length, self.slot_bytes)
+
+    def pop_view(self, timeout_ms=-1):
+        """Memoryview of the tail slot's payload WITHOUT copying.
+        Auto-releases any previous pending view first — so a view (and
+        arrays deserialized out of it) is valid until the NEXT
+        pop_view/release_view on this ring."""
+        if not self._h:
+            return None
+        self.release_view()
+        n = self._c_uint64()
+        ptr = self._lib.ring_pop_view(self._h, self._byref(n),
+                                      timeout_ms)
+        if not ptr:
+            return None
+        self._pending = True
+        arr = (self._c_ubyte * n.value).from_address(ptr)
+        return memoryview(arr).cast("B")
+
+    def release_view(self):
+        if self._pending and self._h:
+            self._lib.ring_pop_release(self._h)
+            self._pending = False
 
     def close(self):
         if self._h:
@@ -122,7 +314,8 @@ def get_worker_info():
 
 def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                  slots, slot_bytes, index_queue, worker_init_fn,
-                 iterable_mode, batch_size, drop_last, base_seed):
+                 iterable_mode, batch_size, drop_last, base_seed,
+                 default_collate=False):
     """Runs in the child process: pull work, compute, push to the ring."""
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset,
@@ -150,15 +343,14 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                         # batch_size=None: raw per-sample values, no
                         # collate (matches the single-process path)
                         for sample in it:
-                            ring.push(pickle.dumps(sample, protocol=5))
+                            _push_batch(ring, sample)
                     else:
                         while True:
                             batch = list(itertools.islice(it, batch_size))
                             if not batch or (len(batch) < batch_size
                                              and drop_last):
                                 break
-                            ring.push(pickle.dumps(collate_fn(batch),
-                                                   protocol=5))
+                            _push_batch(ring, collate_fn(batch))
                 except Exception as e:
                     import traceback
 
@@ -176,8 +368,11 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                 break
             try:
                 samples = [dataset[i] for i in item]
-                payload = pickle.dumps(collate_fn(samples), protocol=5)
-                ring.push(payload)
+                # default collate + zero-copy: stack straight into the
+                # slot (one copy per sample total)
+                if not (default_collate and _zero_copy_enabled()
+                        and _try_push_stacked(ring, samples)):
+                    _push_batch(ring, collate_fn(samples))
             except Exception as e:  # surface the error to the trainer
                 import traceback
 
@@ -192,7 +387,8 @@ class MultiprocessLoader:
 
     def __init__(self, dataset, collate_fn, num_workers, prefetch_factor,
                  slot_mb, worker_init_fn, timeout, persistent,
-                 iterable_mode=False, batch_size=1, drop_last=False):
+                 iterable_mode=False, batch_size=1, drop_last=False,
+                 default_collate=False):
         import multiprocessing as mp
 
         self._mp = mp.get_context("fork")
@@ -217,7 +413,8 @@ class MultiprocessLoader:
                 target=_worker_loop,
                 args=(w, num_workers, dataset, collate_fn, ring_name,
                       slots, slot_bytes, q, worker_init_fn,
-                      iterable_mode, batch_size, drop_last, base_seed),
+                      iterable_mode, batch_size, drop_last, base_seed,
+                      default_collate),
                 daemon=True)
             p.start()
             self.rings.append(ring)
@@ -263,15 +460,17 @@ class MultiprocessLoader:
             feed()
             try:
                 while popped < fed or not done_feeding:
-                    payload = self._pop_checked(
+                    batch = self._pop_checked(
                         self.rings[popped % self.num_workers])
                     popped += 1
                     feed()
-                    yield pickle.loads(payload)
+                    yield batch
             finally:
                 # early exit: flush remaining fed batches + all EOFs
-                # (skip when _pop_checked already shut us down)
-                if self.rings:
+                # (skip when _pop_checked already shut us down, and at
+                # interpreter shutdown, where module globals the drain
+                # needs are already torn down)
+                if self.rings and not _sys.is_finalizing():
                     if not done_feeding:
                         done_feeding = True
                         for q in self.queues:
@@ -295,31 +494,40 @@ class MultiprocessLoader:
                 if w not in live:
                     w = (w + 1) % self.num_workers
                     continue
-                payload = self._pop_checked(self.rings[w])
-                if payload == _EOF:
+                batch = self._pop_checked(self.rings[w])
+                if batch is _EOF:
                     live.discard(w)
                 else:
-                    yield pickle.loads(payload)
+                    yield batch
                 w = (w + 1) % self.num_workers
         finally:
             # early exit: drain until every worker's EOF arrives
-            # (skip when _pop_checked already shut us down)
-            while live and self.rings:
+            # (skip when _pop_checked already shut us down, or at
+            # interpreter shutdown)
+            while live and self.rings and not _sys.is_finalizing():
                 for w in list(live):
-                    payload = self._pop_checked(self.rings[w])
-                    if payload == _EOF:
+                    batch = self._pop_checked(self.rings[w])
+                    if batch is _EOF:
                         live.discard(w)
 
     def _pop_checked(self, ring):
-        """Pop with liveness polling: a worker killed by the OS (or
-        crashed outside the guarded region) must raise, not hang."""
+        """Pop + decode with liveness polling: a worker killed by the
+        OS (or crashed outside the guarded region) must raise, not
+        hang. Returns the decoded batch, or the _EOF marker constant.
+        Zero-copy batches alias the ring slot; the slot is auto-
+        released on the NEXT pop of the same ring (pop_view), so a
+        yielded batch stays valid until that worker's next batch is
+        fetched — W batches of slack in the round-robin order."""
         tick = 2000
         waited = 0
         while True:
+            if not self.procs:
+                raise RuntimeError("DataLoader was shut down while "
+                                   "batches were still pending")
             budget = (self.timeout_ms if self.timeout_ms > 0
                       else tick)
-            payload = ring.pop(min(budget, tick))
-            if payload is not None:
+            view = ring.pop_view(min(budget, tick))
+            if view is not None:
                 break
             waited += tick
             if self.timeout_ms > 0 and waited >= self.timeout_ms:
@@ -332,12 +540,20 @@ class MultiprocessLoader:
                 raise RuntimeError(
                     "a DataLoader worker process died unexpectedly "
                     "(killed or crashed) — see worker logs")
+        batch = _decode_view(view)
+        if batch is not None:
+            return batch
+        payload = bytes(view)
+        view.release()
+        ring.release_view()
+        if payload == _EOF:
+            return _EOF
         if payload.startswith(_ERR):
             name, tb = pickle.loads(payload[len(_ERR):])
             self.shutdown()
             raise RuntimeError(
                 f"DataLoader worker raised {name}:\n{tb}")
-        return payload
+        return pickle.loads(payload)
 
     def shutdown(self):
         for q in self.queues:

@@ -101,11 +101,16 @@ def export_chrome_tracing(dir_name, worker_name=None):
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False):
+                 with_flops=False, python_tracer=True):
+        """python_tracer=False drops the per-python-frame device-plane
+        events from the jax capture — on very large programs (e.g. a
+        fully unrolled transformer) the python plane alone runs to ~1M
+        events and crowds the XLA op plane out of the merged export."""
         self._targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        self._python_tracer = python_tracer
         self._step = 0
         self._jax_dir = None
         self._step_times = []
@@ -126,7 +131,15 @@ class Profiler:
             try:
                 import jax
 
-                jax.profiler.start_trace(self._jax_dir)
+                opts = None
+                if not self._python_tracer:
+                    try:
+                        opts = jax.profiler.ProfileOptions()
+                        opts.python_tracer_level = 0
+                    except Exception:
+                        opts = None
+                jax.profiler.start_trace(self._jax_dir,
+                                         profiler_options=opts)
             except Exception:
                 self._jax_dir = None
 

@@ -130,6 +130,70 @@ int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
   }
 }
 
+// ---- zero-copy variants (r5) ----------------------------------------
+// The copying push/pop above move every batch twice (worker buf ->
+// slot, slot -> trainer buf). These variants expose the slot memory
+// itself: the producer writes its serialized batch straight into the
+// reserved slot; the consumer reads (deserializes out-of-band numpy
+// buffers) directly from the slot and releases it afterwards. With
+// pickle protocol-5 out-of-band buffers the batch arrays alias shared
+// memory end to end — the only full copy left on the consumer side is
+// the host->device transfer (the reference's mmap_allocator.cc
+// shared-memory-tensor semantics).
+
+// Pointer to the payload area of the next free slot, or null on
+// timeout. Single producer: at most one reservation outstanding.
+uint8_t* ring_push_reserve(void* handle, int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (head - tail < r->hdr->slots) return slot_ptr(r, head) + 8;
+    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
+    usleep(200);
+    waited_us += 200;
+  }
+}
+
+// Publish the reserved slot with `len` payload bytes. 0 ok, -2 too big.
+int ring_push_commit(void* handle, uint64_t len) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  if (len > r->hdr->slot_bytes) return -2;
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  std::memcpy(slot_ptr(r, head), &len, 8);
+  r->hdr->head.store(head + 1, std::memory_order_release);
+  return 0;
+}
+
+// Pointer to the current tail slot's payload (no copy, no consume), or
+// null on timeout. *len_out receives the payload length. The slot
+// stays owned by the consumer until ring_pop_release.
+uint8_t* ring_pop_view(void* handle, uint64_t* len_out,
+                       int64_t timeout_ms) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (tail < head) {
+      uint8_t* p = slot_ptr(r, tail);
+      std::memcpy(len_out, p, 8);
+      return p + 8;
+    }
+    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
+    usleep(200);
+    waited_us += 200;
+  }
+}
+
+// Release the slot returned by ring_pop_view (advance tail).
+void ring_pop_release(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  r->hdr->tail.store(tail + 1, std::memory_order_release);
+}
+
 // Number of filled slots (diagnostic).
 uint64_t ring_size(void* handle) {
   Ring* r = reinterpret_cast<Ring*>(handle);

@@ -132,3 +132,39 @@ def test_sparse_divide_dense_lhs_raises():
     sp = sparse.to_sparse_coo(paddle.to_tensor(dense))
     with pytest.raises(TypeError, match="dividend must be sparse"):
         sparse.divide(paddle.to_tensor(np.ones((2, 2), np.float32)), sp)
+
+
+# -- r5 zero-copy loader: raw-mode batch ownership -----------------------
+
+from collections import namedtuple as _namedtuple
+
+_NTBatch = _namedtuple("_NTBatch", ["x", "y"])
+
+
+class _NTDS:
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((4, 4), i, np.float32), np.int64(i)
+
+
+def _nt_collate(samples):
+    xs, ys = zip(*samples)
+    return _NTBatch(np.stack(xs), np.stack(ys))
+
+
+def test_raw_collate_preserves_types_and_owns_data():
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(_NTDS(), batch_size=4, num_workers=2,
+                    use_shared_memory=True, collate_fn=_nt_collate)
+    batches = list(dl)  # worker pool shuts down here (rings munmap)
+    assert len(batches) == 4
+    for b in batches:
+        assert type(b).__name__ == "_NTBatch" and hasattr(b, "x")
+        # every array must OWN its data: slot views after shutdown
+        # would read unmapped memory
+        assert b.x.base is None or b.x.flags.owndata
+        first = int(b.y[0])
+        np.testing.assert_allclose(b.x[0], np.full((4, 4), first))
