@@ -1,0 +1,54 @@
+"""BERT/ERNIE-side experiments (r5): attention blocks at S=512 and
+the recorded-config bench numbers, using bench.py's own methodology."""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def attn_sweep():
+    import jax.numpy as jnp  # noqa
+    from attn_bench import time_fwd_bwd
+    from paddle_tpu.incubate.nn.attention_pallas import flash_attention
+
+    B, H, S, D = 32, 12, 512, 64
+    fwd_fl = 2 * 2 * B * H * S * S * D  # non-causal (BERT)
+    tot_fl = fwd_fl * 3.5
+    for bq, bk in [(512, 512), (256, 256), (512, 256), (128, 128)]:
+        fn = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, False, 1.0 / np.sqrt(D), bq, bk)
+        try:
+            dt = time_fwd_bwd(fn, B, H, S, D, n=4)
+            print("[enc]", json.dumps(
+                {"attn": f"bq{bq}_bk{bk}", "ms": round(dt * 1e3, 3),
+                 "tflops": round(tot_fl / dt / 1e12, 1)}), flush=True)
+        except Exception as e:
+            print("[enc]", json.dumps({"attn": f"bq{bq}_bk{bk}",
+                                       "error": str(e)[:160]}), flush=True)
+
+
+def bench_models():
+    import bench
+
+    for name, fn in (("bert", bench.bench_bert),
+                     ("ernie", bench.bench_ernie)):
+        try:
+            r = fn(True)
+            r.pop("window_spread", None)
+            print("[enc]", json.dumps({name: r}), flush=True)
+        except Exception as e:
+            print("[enc]", json.dumps({name: f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    attn_sweep()
+    bench_models()

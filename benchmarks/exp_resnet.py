@@ -1,0 +1,78 @@
+"""ResNet-50 config sweep (r5 perf round): bench.py methodology.
+
+usage: python benchmarks/exp_resnet.py '{"name":"b128"}' \
+           '{"name":"b256","batch":256}' ...
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_variant(spec):
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.amp as amp
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.jit import TrainStepCompiler
+    from paddle_tpu.vision.models import resnet50
+
+    spec = dict(spec)
+    name = spec.pop("name")
+    batch = spec.pop("batch", 128)
+    steps = spec.pop("steps", 60)
+    warmup = spec.pop("warmup", 5)
+    windows = spec.pop("windows", 3)
+    paddle.seed(0)
+    net = resnet50()
+    net = amp.decorate(net, level="O2", dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=net.parameters(),
+                         multi_precision=True)
+    step = TrainStepCompiler(net, opt, lambda o, y: ce(o, y))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224)
+                         .astype(np.float32))
+    x._value = x._value.astype(jnp.bfloat16)
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        loss = step(x, y)
+    first = float(loss.item())
+    compile_s = time.perf_counter() - t0
+    dts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y)
+        last = float(loss.item())
+        dts.append((time.perf_counter() - t0) / steps)
+    dt = float(np.median(dts))
+    assert np.isfinite(last) and last < first, (name, first, last)
+    mfu = 3 * 4.09e9 * batch / dt / 197e12
+    rec = {"name": name, "imgs_s": round(batch / dt, 1),
+           "ms_step": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+           "compile_s": round(compile_s, 1)}
+    print("[res]", json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    for arg in sys.argv[1:]:
+        spec = json.loads(arg)
+        try:
+            run_variant(spec)
+        except Exception as e:
+            print("[res]", json.dumps({"name": spec.get("name"),
+                                       "error": f"{type(e).__name__}: "
+                                                f"{str(e)[:300]}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,262 @@
+"""Per-op micro-benchmark harness + regression record (r4 verdict
+missing #5).
+
+Parity target: paddle/fluid/operators/benchmark/op_tester.cc +
+tools/ci_op_benchmark.sh — a config-driven per-op timing harness whose
+JSON record lets the next round diff per-op performance instead of
+discovering regressions at the model level.
+
+Methodology (BASELINE.md r4 corrected-probe rules): ops are chained
+serially inside one jitted lax.scan (XLA cannot batch or elide
+iterations whose input depends on the previous output), timing uses
+device-get syncs (block_until_ready lies on the tunnel backend), and
+two scan lengths cancel the tunnel RTT: t = (T(2n) - T(n)) / n.
+
+usage:
+    python benchmarks/op_bench.py                  # run all, print
+    python benchmarks/op_bench.py --save           # + write baseline
+    python benchmarks/op_bench.py --check [--tol 0.25]
+        # compare against the committed baseline; exit 1 on any op
+        # slower than baseline*(1+tol) — the CI regression gate
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "artifacts",
+                             "op_bench_baseline.json")
+
+
+def jnp_sum_f32(a):
+    import jax.numpy as jnp
+
+    return jnp.sum(a.astype(jnp.float32))
+
+
+def _chain_time(step_fn, init, n=16, reps=3, min_diff_s=0.03):
+    """Serial-chain timing: median over `reps` of (T(2n)-T(n))/n.
+
+    The chain length adapts upward until the measured difference
+    clears the tunnel's RTT jitter (~tens of ms) — a fixed short chain
+    under-resolves cheap ops into noise (or 0)."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chain(x0, length):
+        def body(c, _):
+            return step_fn(c), None
+
+        out, _ = jax.lax.scan(body, x0, None, length=length)
+        # sync value must depend on EVERY element: reading one element
+        # lets XLA slice the whole elementwise chain down to scalar
+        # ops (BASELINE.md corrected-probe rules). The extra reduce is
+        # identical at both lengths, so (T(2n)-T(n)) cancels it.
+        return jax.tree_util.tree_map(
+            lambda a: jnp_sum_f32(a), out)
+
+    def run(length):
+        t0 = time.perf_counter()
+        out = chain(init, length)
+        _ = [float(np.asarray(o)) for o in
+             jax.tree_util.tree_leaves(out)]  # device-get sync
+        return time.perf_counter() - t0
+
+    while True:
+        run(n)
+        run(2 * n)
+        diff = min(run(2 * n) for _ in range(2)) - min(
+            run(n) for _ in range(2))
+        if diff >= min_diff_s or n >= 4096:
+            break
+        n *= 4
+    ts_n = [run(n) for _ in range(reps)]
+    ts_2n = [run(2 * n) for _ in range(reps)]
+    return max((float(np.median(ts_2n)) - float(np.median(ts_n))) / n,
+               1e-9)
+
+
+def _f32(rng, *shape):
+    import jax.numpy as jnp
+
+    return jnp.asarray(rng.randn(*shape), jnp.float32)
+
+
+def _bf16(rng, *shape):
+    import jax.numpy as jnp
+
+    return jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+
+
+def build_ops():
+    """name -> (init_carry, step_fn, work_dict). step_fn must be
+    shape-preserving on the carry (serial chain)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    ops = {}
+
+    # -- MXU ----------------------------------------------------------
+    # abs() in every linear chain: without a nonlinearity XLA folds
+    # the unrolled iterations ((x@W)*c chains precompute to one
+    # effective matrix; affine elementwise chains fold to one op)
+    w1 = _bf16(rng, 1024, 1024)
+    ops["matmul_4096x1024x1024_bf16"] = (
+        _bf16(rng, 4096, 1024),
+        lambda x: jnp.abs(x @ w1) * jnp.bfloat16(0.001),
+        {"flops": 2 * 4096 * 1024 * 1024})
+    w2 = _bf16(rng, 4096, 4096)
+    ops["matmul_4096x4096x4096_bf16"] = (
+        _bf16(rng, 4096, 4096),
+        lambda x: jnp.abs(x @ w2) * jnp.bfloat16(0.0001),
+        {"flops": 2 * 4096 * 4096 * 4096})
+    kw = _bf16(rng, 3, 3, 256, 256)
+    ops["conv2d_3x3_56x56x256_bf16"] = (
+        _bf16(rng, 32, 56, 56, 256),
+        lambda x: jnp.abs(jax.lax.conv_general_dilated(
+            x, kw, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")))
+        * jnp.bfloat16(0.01),
+        {"flops": 2 * 32 * 56 * 56 * 256 * 256 * 9})
+
+    # -- VPU / HBM ----------------------------------------------------
+    big = _f32(rng, 4096, 4096)
+    ops["add_abs_16M_f32"] = (big, lambda x: jnp.abs(x + 1.0),
+                              {"bytes": 2 * big.nbytes})
+    ops["multiply_abs_16M_f32"] = (
+        big, lambda x: jnp.abs(x * 1.0000001) * -1.0,
+        {"bytes": 2 * big.nbytes})
+    ops["exp_16M_f32"] = (big * 1e-6, lambda x: jnp.exp(x) * 1e-6,
+                          {"bytes": 2 * big.nbytes})
+    ops["reduce_sum_16M_f32"] = (
+        big, lambda x: jnp.abs(x + (jnp.sum(x) * 1e-20)),
+        {"bytes": big.nbytes})
+    ops["softmax_4096x4096_f32"] = (
+        big, lambda x: jax.nn.softmax(x, axis=-1) + x * 1e-6,
+        {"bytes": 4 * big.nbytes})
+    ops["transpose_4096x4096_f32"] = (
+        big, lambda x: jnp.abs(jnp.transpose(x)),
+        {"bytes": 2 * big.nbytes})
+    ln_w = _f32(rng, 1024)
+    act = _bf16(rng, 4096, 1024)
+    ops["layer_norm_4096x1024_bf16"] = (
+        act,
+        lambda x: ((x.astype(jnp.float32)
+                    - jnp.mean(x.astype(jnp.float32), -1,
+                               keepdims=True))
+                   * jax.lax.rsqrt(
+                       jnp.var(x.astype(jnp.float32), -1,
+                               keepdims=True) + 1e-5)
+                   * ln_w).astype(jnp.bfloat16),
+        {"bytes": 2 * act.nbytes})
+
+    # -- memory / indexing -------------------------------------------
+    table = _f32(rng, 50304, 256)
+    idx = np.random.RandomState(1).randint(0, 50304, (8192,))
+    idx_j = jnp.asarray(idx, jnp.int32)
+    def _gather(x):
+        # indices derive from the carry so the take cannot hoist out
+        # of the loop as a loop-invariant
+        shift = jnp.int32(jnp.abs(x[0, 0]) * 1e-20)
+        return jnp.take(table, idx_j + shift, axis=0) + x * 1e-6
+
+    ops["gather_8192_of_50304x256"] = (
+        _f32(rng, 8192, 256), _gather,
+        {"bytes": 2 * 8192 * 256 * 4})
+    ops["scatter_add_8192_into_50304x256"] = (
+        table,
+        lambda t: t.at[idx_j].add(jnp.float32(1e-7)),
+        {"bytes": 2 * 8192 * 256 * 4})
+
+    # -- fused attention ---------------------------------------------
+    try:
+        from paddle_tpu.incubate.nn.attention_pallas import (
+            flash_attention)
+
+        q = _bf16(rng, 4, 16, 1024, 64)
+        kv = _bf16(rng, 4, 16, 1024, 64)
+
+        def fa(x):
+            o = flash_attention(x, kv, kv, True, 0.125)
+            return (x + o * jnp.bfloat16(1e-6))
+
+        ops["flash_attention_fwd_4x16x1024x64"] = (
+            q, fa, {"flops": 2 * 2 * 4 * 16 * 1024 * 1024 * 64 // 2})
+    except Exception:
+        pass
+    return ops
+
+
+def run_all(n=16):
+    results = {}
+    for name, (init, step, work) in build_ops().items():
+        try:
+            dt = _chain_time(step, init, n=n)
+            rec = {"us": round(dt * 1e6, 2)}
+            if "flops" in work:
+                rec["tflops"] = round(work["flops"] / dt / 1e12, 2)
+            if "bytes" in work:
+                rec["gbps"] = round(work["bytes"] / dt / 1e9, 1)
+            results[name] = rec
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: "
+                                      f"{str(e)[:160]}"}
+        print("[op]", name, json.dumps(results[name]), flush=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--save", action="store_true",
+                    help="write the baseline record")
+    ap.add_argument("--check", action="store_true",
+                    help="gate against the committed baseline")
+    ap.add_argument("--tol", type=float, default=0.25)
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    results = run_all()
+    out = {"platform": platform, "ops": results}
+    print(json.dumps(out))
+    if args.save:
+        os.makedirs(os.path.dirname(BASELINE_PATH), exist_ok=True)
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"baseline written: {BASELINE_PATH}", file=sys.stderr)
+    if args.check:
+        if not os.path.exists(BASELINE_PATH):
+            print("no baseline to check against", file=sys.stderr)
+            return 1
+        with open(BASELINE_PATH) as f:
+            base = json.load(f)
+        if base.get("platform") != platform:
+            print(f"baseline platform {base.get('platform')} != "
+                  f"{platform}; skipping gate", file=sys.stderr)
+            return 0
+        bad = []
+        for name, rec in results.items():
+            b = base["ops"].get(name, {})
+            if "us" in rec and "us" in b:
+                if rec["us"] > b["us"] * (1 + args.tol):
+                    bad.append((name, b["us"], rec["us"]))
+        for name, was, now in bad:
+            print(f"REGRESSION {name}: {was}us -> {now}us",
+                  file=sys.stderr)
+        return 1 if bad else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

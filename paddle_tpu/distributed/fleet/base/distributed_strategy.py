@@ -47,6 +47,10 @@ _FIELDS = {
     "tensor_parallel": False,
     "lamb": False,
     "lars": False,
+    # exact periodic-averaging DP (fleet/meta_optimizers LocalSGD —
+    # r4 verdict: exact algorithm, wrongly lumped with dgc before)
+    "localsgd": False,
+    "adaptive_localsgd": False,
     "asp": False,
     "qat": False,
     # parameter-server modes (consumed by distributed/ps: a_sync=True
@@ -77,6 +81,12 @@ _CONFIG_FIELDS = {
     "gradient_merge_configs": (
         {"k_steps": 1, "avg": True},
         {"k_steps", "avg"}),
+    "localsgd_configs": (
+        {"k_steps": 1, "begin_step": 1},
+        {"k_steps", "begin_step"}),
+    "adaptive_localsgd_configs": (
+        {"init_k_steps": 1, "begin_step": 1},
+        {"init_k_steps", "begin_step"}),
     "sharding_configs": (
         {"sharding_degree": 1, "mp_degree": 1, "pp_degree": 1,
          "dp_degree": 1, "stage": 1, "offload": False,
@@ -120,17 +130,14 @@ _CONFIG_FIELDS = {
 # raises NotImplementedError here, at the assignment site (falsy
 # assignment is allowed so ported code that resets defaults works).
 _APPROX_GRAD_RATIONALE = (
-    "approximate-gradient communication optimizers are intentionally "
+    "lossy gradient-compression optimizers are intentionally "
     "unsupported on TPU: in-step allreduce over ICI is exact and "
-    "bandwidth-cheap, so gradient compression / periodic sync would "
-    "only hurt convergence.")
+    "bandwidth-cheap, so compressing gradients would only hurt "
+    "convergence. (LocalSGD, an EXACT algorithm, IS supported — see "
+    "fleet/meta_optimizers.)")
 _UNSUPPORTED = {
     "dgc": _APPROX_GRAD_RATIONALE,
     "dgc_configs": _APPROX_GRAD_RATIONALE,
-    "localsgd": _APPROX_GRAD_RATIONALE,
-    "localsgd_configs": _APPROX_GRAD_RATIONALE,
-    "adaptive_localsgd": _APPROX_GRAD_RATIONALE,
-    "adaptive_localsgd_configs": _APPROX_GRAD_RATIONALE,
     "fp16_allreduce": (
         "grad-allreduce runs inside the compiled step where XLA already "
         "keeps bf16 grads in bf16 over ICI; a separate cast-for-comm "

@@ -1,0 +1,203 @@
+"""LocalSGD meta-optimizers (r4 verdict missing #3 — un-rejected).
+
+Parity target:
+python/paddle/distributed/fleet/meta_optimizers/localsgd_optimizer.py
+(LocalSGDOptimizer, AdaptiveLocalSGDOptimizer). The reference rewrites
+the static Program: every parameter gets a snapshot var; every k-th
+step it all-reduces (snapshot - param), scales by 1/nranks, and
+rebuilds param = snapshot - avg_delta (delta-averaging — equal to
+param averaging when replicas share the snapshot, but robust to
+stragglers joining late). Before `begin_step` it communicates EVERY
+step. The adaptive variant re-derives k each communication from
+    k_next = clip(ceil(sqrt(lr_0 * loss_t / (lr_t * loss_0) * k_0)),
+                  1, 16)
+with loss_0/lr_0 captured at the first step (Lin et al., "Don't Use
+Large Mini-Batches, Use Local SGD" / adaptive-comm follow-up — the
+reference's exact formula, localsgd_optimizer.py:437).
+
+TPU-native design: LocalSGD is an EAGER data-parallel optimizer
+wrapper — one process per device, local steps diverge the replicas,
+and the periodic averaging is an eager all_reduce over the TCP-store
+collective world (the reference's c_allreduce_sum ring analog). It is
+exact (no gradient approximation). The GSPMD compiled path keeps
+parameters replicated inside one XLA program, where per-replica
+divergence cannot exist — apply_gradients raises loudly instead of
+silently degrading to plain local steps.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["LocalSGDOptimizer", "AdaptiveLocalSGDOptimizer"]
+
+
+class LocalSGDOptimizer:
+    """k local steps, then delta-average parameters across the world.
+
+    usage (eager DP, one process per device):
+        opt = optim.Momentum(..., parameters=model.parameters())
+        opt = LocalSGDOptimizer(opt, k_steps=4)
+        loss.backward(); opt.step(); opt.clear_grad()
+    """
+
+    def __init__(self, optimizer, k_steps=1, begin_step=1):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = optimizer
+        self.k_steps = int(k_steps)
+        self.begin_step = int(begin_step)
+        self._step_count = 0
+        self._last_comm_step = 0
+        self._snapshots = None  # param id -> np snapshot at last comm
+
+    # -- delegation ----------------------------------------------------
+    def __getattr__(self, name):
+        if name == "_inner":  # unpickle/copy create instances without
+            raise AttributeError(name)  # __init__ — avoid recursion
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def _params(self):
+        return list(self._inner._parameter_list)
+
+    # -- the wrapper ---------------------------------------------------
+    def step(self):
+        from ... import env as dist_env
+
+        world = dist_env.get_world_size()
+        if world > 1:
+            # the snapshot is the state at the LAST sync point — it
+            # must be captured BEFORE the first local step (reference
+            # init_snapshot_vars assigns param -> snapshot at startup)
+            self._ensure_snapshots(self._params())
+        self._inner.step()
+        self._step_count += 1
+        if world <= 1:
+            return
+        if self._step_count <= self.begin_step:
+            self._communicate()  # reference: sync every step early on
+        elif self._step_count - self._last_comm_step >= self.k_steps:
+            self._communicate()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # must NOT delegate to the inner minimize (its self.step()
+        # would skip the communication — review r5)
+        loss.backward()
+        self.step()
+        return None, None
+
+    def apply_gradients(self, *a, **kw):
+        raise NotImplementedError(
+            "LocalSGD is an eager data-parallel wrapper (per-process "
+            "replicas diverge between communications); the compiled "
+            "GSPMD step keeps parameters replicated so local "
+            "divergence cannot exist there — use sync DP (plain "
+            "compiled step) or run the eager loop with opt.step()")
+
+    def _ensure_snapshots(self, params):
+        if self._snapshots is None:
+            self._snapshots = {
+                id(p): np.asarray(p._value).copy() for p in params}
+
+    def _communicate(self):
+        """param <- snapshot - mean_world(snapshot - param);
+        snapshot <- param (reference communicate() sub-block)."""
+        from ... import collective as dist
+        from ... import env as dist_env
+        from ....core.tensor import Tensor
+
+        params = self._params()
+        self._ensure_snapshots(params)
+        world = dist_env.get_world_size()
+        for p in params:
+            snap = self._snapshots[id(p)]
+            delta = Tensor(snap - np.asarray(p._value))
+            dist.all_reduce(delta)
+            new_val = snap - np.asarray(delta._value) / float(world)
+            p.set_value(new_val.astype(snap.dtype))
+            self._snapshots[id(p)] = new_val.astype(snap.dtype)
+        self._last_comm_step = self._step_count
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """LocalSGD whose k adapts to training progress (reference
+    AdaptiveLocalSGDOptimizer): communication gets rarer as the loss
+    drops. Call step(loss) so the wrapper can see the loss."""
+
+    MAX_K = 16  # reference max_local_steps
+    MIN_K = 1
+
+    def __init__(self, optimizer, init_k_steps=1, begin_step=1):
+        super().__init__(optimizer, k_steps=init_k_steps,
+                         begin_step=begin_step)
+        self.init_k_steps = int(init_k_steps)
+        self._loss0 = None
+        self._lr0 = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step(loss)
+        return None, None
+
+    def step(self, loss=None):
+        from ... import collective as dist
+        from ... import env as dist_env
+
+        world = dist_env.get_world_size()
+        if world > 1:
+            self._ensure_snapshots(self._params())
+        self._inner.step()
+        self._step_count += 1
+        if world <= 1:
+            return
+        if loss is None:
+            raise ValueError(
+                "AdaptiveLocalSGDOptimizer.step(loss) needs the loss "
+                "to adapt k (reference avg_loss feedback)")
+        lv = float(loss.item() if hasattr(loss, "item") else loss)
+        lr = float(self._inner.get_lr())
+        if self._loss0 is None:
+            # reference initialize(): world-averaged first loss
+            from ....core.tensor import Tensor
+
+            t = Tensor(np.asarray([lv], np.float32))
+            dist.all_reduce(t)
+            self._loss0 = float(np.asarray(t._value)[0]) / world
+            self._lr0 = lr if lr > 0 else 1.0
+        if self._step_count <= self.begin_step:
+            self._communicate()
+            self._adapt_k(lv, lr, world)
+        elif self._step_count - self._last_comm_step >= self.k_steps:
+            self._communicate()
+            self._adapt_k(lv, lr, world)
+
+    def _adapt_k(self, local_loss, lr, world):
+        from ... import collective as dist
+        from ... import env as dist_env
+        from ....core.tensor import Tensor
+
+        t = Tensor(np.asarray([local_loss], np.float32))
+        dist.all_reduce(t)
+        avg_loss = float(np.asarray(t._value)[0]) / world
+        lr = lr if lr > 0 else self._lr0
+        # a first-step loss of exactly 0 (resumed/converged model)
+        # must not divide-by-zero the adaptation — fall back to k_0
+        denom = lr * self._loss0
+        if denom <= 0.0:
+            self.k_steps = max(self.MIN_K,
+                               min(self.MAX_K, self.init_k_steps))
+            return
+        ratio = (self._lr0 * avg_loss) / denom
+        k = int(math.ceil(math.sqrt(max(ratio, 0.0)
+                                    * self.init_k_steps)))
+        self.k_steps = max(self.MIN_K, min(self.MAX_K, k))

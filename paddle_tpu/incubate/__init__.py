@@ -4,3 +4,6 @@ from . import autograd
 from . import distributed
 from . import checkpoint
 from . import asp
+# reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

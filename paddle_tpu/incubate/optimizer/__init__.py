@@ -1,0 +1,9 @@
+"""paddle.incubate.optimizer (reference:
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py) — a real
+subpackage so the reference's canonical import form works:
+    from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+"""
+from ...optimizer.averaging import (  # noqa: F401
+    ExponentialMovingAverage, LookAhead, ModelAverage)
+
+__all__ = ["ExponentialMovingAverage", "LookAhead", "ModelAverage"]

@@ -456,7 +456,13 @@ class DataLoader:
             batches = ([i] for i in range(len(self.dataset)))
         else:
             batches = []
+        from .worker import _zero_copy_enabled
+
         raw = self.collate_fn is not None
+        # detach only when zero-copy transport is on: plain-pickle
+        # batches already own immutable bytes-backed data, and copying
+        # them would add a gratuitous full-batch memcpy (review)
+        detach = raw and _zero_copy_enabled()
         try:
             for batch in loader.run_epoch(batches):
                 # zero-copy batches alias the shm ring slot, valid only
@@ -466,7 +472,10 @@ class DataLoader:
                 # hands out numpy arrays, so detach slot-aliasing ones
                 # with one memcpy (still 3 copies cheaper than the old
                 # pickle+ring+unpickle transport).
-                yield _detach_views(batch) if raw else _to_device(batch)
+                if raw:
+                    yield _detach_views(batch) if detach else batch
+                else:
+                    yield _to_device(batch)
         finally:
             if owned:
                 loader.shutdown()

@@ -131,8 +131,15 @@ def _try_push_stacked(ring, samples):
             if len(s) != k:
                 return False
             for i in range(k):
-                np.copyto(views[i][j], np.asarray(s[i]),
-                          casting="same_kind")
+                src = np.asarray(s[i])
+                if src.shape != layout[i][1][1:]:
+                    # np.stack would raise on ragged samples — don't
+                    # silently broadcast a wrong-shaped one (review);
+                    # the generic fallback surfaces the real error
+                    return False
+                # [j, ...] keeps a 0-d ndarray view for scalar fields
+                # (plain [j] yields a numpy scalar copyto rejects)
+                np.copyto(views[i][j, ...], src, casting="same_kind")
         # meta: pickle the slot-aliasing arrays out-of-band — the
         # buffer table then points at the bodies already in the slot
         bufs = []

@@ -6,10 +6,12 @@ import jax.numpy as jnp
 from .optimizer import Optimizer
 from . import lr
 from .lr import LRScheduler
+from .averaging import ExponentialMovingAverage, LookAhead, ModelAverage
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
     "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "lr",
+    "ExponentialMovingAverage", "LookAhead", "ModelAverage",
 ]
 
 

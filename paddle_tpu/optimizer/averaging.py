@@ -1,0 +1,285 @@
+"""Parameter-averaging optimizer wrappers (r4 verdict missing #4).
+
+Parity targets:
+- ExponentialMovingAverage —
+  /root/reference/python/paddle/fluid/optimizer.py:4075 (shadow
+  EMA_t = decay*EMA_{t-1} + (1-decay)*theta_t, bias-corrected by
+  1/(1-decay^t) at apply(); thres_steps schedules
+  decay_t = min(decay, (1+t)/(10+t)); update()/apply()/restore()).
+- LookAhead — /root/reference/python/paddle/incubate/optimizer/
+  lookahead.py:26 (inner optimizer updates the fast weights every
+  step; every k steps slow += alpha*(fast-slow), fast = slow).
+- ModelAverage — /root/reference/python/paddle/incubate/optimizer/
+  modelaverage.py:28 (accumulate parameter sums; apply() swaps in the
+  window average when num_accumulates >= min_average_window and
+  >= min(max_average_window, num_updates*average_window_rate)).
+
+TPU-native: all three operate on host-held jnp arrays between steps —
+they are state machines around the compiled/eager step, not graph
+rewrites, so they compose with any inner optimizer (the reference
+builds them as program passes because its optimizer IS a graph
+rewrite).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["ExponentialMovingAverage", "LookAhead", "ModelAverage"]
+
+
+class ExponentialMovingAverage:
+    """shadow_t = decay*shadow_{t-1} + (1-decay)*param_t with bias
+    correction at apply time.
+
+    usage:
+        ema = ExponentialMovingAverage(model.parameters(), decay=0.999)
+        ...inside the train loop, after opt.step():
+        ema.update()
+        ...at eval:
+        with ema.apply(model.parameters() is implicit):
+            evaluate(model)
+    """
+
+    def __init__(self, parameters=None, decay=0.999, thres_steps=None,
+                 name=None):
+        self._params = list(parameters or [])
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._t = 0
+        self._shadow = {id(p): jnp.zeros_like(
+            p._value, dtype=jnp.float32) for p in self._params}
+        self._backup = None
+
+    def _decay_t(self):
+        if self._thres_steps is not None:
+            ts = float(self._thres_steps() if callable(self._thres_steps)
+                       else self._thres_steps)
+            return min(self._decay, (1.0 + ts) / (10.0 + ts))
+        return self._decay
+
+    def update(self):
+        """Fold the current parameter values into the shadow EMAs."""
+        d = self._decay_t()
+        self._t += 1
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p._value.astype(
+                jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap bias-corrected EMAs into the parameters."""
+        corr = 1.0 - self._decay ** max(self._t, 1)
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            ema = self._shadow[id(p)] / corr
+            p._value = ema.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._value = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self):
+        return {
+            "t": self._t,
+            "decay": self._decay,
+            "shadow": [np.asarray(self._shadow[id(p)])
+                       for p in self._params],
+        }
+
+    def set_state_dict(self, state):
+        self._t = int(state["t"])
+        self._decay = float(state["decay"])
+        for p, s in zip(self._params, state["shadow"]):
+            self._shadow[id(p)] = jnp.asarray(s, jnp.float32)
+
+
+class _InnerWrapper:
+    """Shared delegation for optimizer wrappers."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        if name == "_inner":  # unpickle/copy create instances without
+            raise AttributeError(name)  # __init__ — avoid recursion
+        return getattr(self._inner, name)
+
+    @property
+    def inner_optimizer(self):
+        return self._inner
+
+    def clear_grad(self, *a, **kw):
+        self._inner.clear_grad(*a, **kw)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class LookAhead(_InnerWrapper):
+    """fast weights step every call; slow weights interpolate every k
+    steps: slow += alpha*(fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        super().__init__(inner_optimizer)
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._la_step = 0
+        self._slow = None
+
+    def _params(self):
+        return list(self._inner._parameter_list or [])
+
+    def step(self):
+        params = self._params()
+        if self._slow is None:
+            self._slow = {id(p): p._value.astype(jnp.float32)
+                          for p in params}
+        self._inner.step()
+        self._la_step += 1
+        if self._la_step % self.k == 0:
+            a = self.alpha
+            for p in params:
+                slow = self._slow[id(p)]
+                new_slow = slow + a * (p._value.astype(jnp.float32)
+                                       - slow)
+                self._slow[id(p)] = new_slow
+                p._value = new_slow.astype(p._value.dtype)
+
+    def state_dict(self):
+        sd = self._inner.state_dict()
+        sd["@lookahead"] = {
+            "la_step": self._la_step, "alpha": self.alpha, "k": self.k,
+            "slow": ([np.asarray(self._slow[id(p)])
+                      for p in self._params()]
+                     if self._slow is not None else None),
+        }
+        return sd
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        la = state.pop("@lookahead", None)
+        self._inner.set_state_dict(state)
+        if la:
+            self._la_step = int(la["la_step"])
+            self.alpha = float(la["alpha"])
+            self.k = int(la["k"])
+            if la["slow"] is not None:
+                self._slow = {id(p): jnp.asarray(s, jnp.float32)
+                              for p, s in zip(self._params(),
+                                              la["slow"])}
+
+
+class ModelAverage(_InnerWrapper):
+    """Accumulate parameter sums each step; apply() swaps the window
+    average in (reference sum_1/sum_2/sum_3 tiers collapse to one
+    running sum + count — numerically identical, the tiers exist in
+    the reference only to bound fp32 accumulation error in-graph)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 inner_optimizer=None, name=None):
+        # reference signature has the rate first; the wrapper works
+        # standalone (accumulate()) or around an inner optimizer
+        super().__init__(inner_optimizer)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = list(parameters or
+                            (inner_optimizer._parameter_list
+                             if inner_optimizer is not None else []))
+        self._sum = {id(p): jnp.zeros_like(p._value, dtype=jnp.float32)
+                     for p in self._params}
+        self._num_accumulates = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def __getattr__(self, name):
+        if name == "_inner":
+            raise AttributeError(name)
+        if self._inner is None:
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def step(self):
+        if self._inner is None:
+            raise RuntimeError("ModelAverage.step() needs an "
+                               "inner_optimizer; otherwise call "
+                               "accumulate() after your own step")
+        self._inner.step()
+        self.accumulate()
+
+    def accumulate(self):
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._value.astype(
+                jnp.float32)
+        # window restart (reference conditional, modelaverage.py:49)
+        limit = min(self.max_average_window,
+                    int(self._num_updates * self.average_window) or 1)
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= limit):
+            # keep the newest accumulation only (reference moves
+            # sum_1 <- current sums and zeroes the rest); here the
+            # running sum restarts from the current params
+            self._num_accumulates = 1
+            for p in self._params:
+                self._sum[id(p)] = p._value.astype(jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._params}
+        n = max(self._num_accumulates, 1)
+        for p in self._params:
+            avg = self._sum[id(p)] / n
+            p._value = avg.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._value = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self):
+        sd = self._inner.state_dict() if self._inner is not None else {}
+        sd["@model_average"] = {
+            "num_accumulates": self._num_accumulates,
+            "num_updates": self._num_updates,
+            "sum": [np.asarray(self._sum[id(p)]) for p in self._params],
+        }
+        return sd
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        ma = state.pop("@model_average", None)
+        if self._inner is not None and state:
+            self._inner.set_state_dict(state)
+        if ma:
+            self._num_accumulates = int(ma["num_accumulates"])
+            self._num_updates = int(ma["num_updates"])
+            for p, s in zip(self._params, ma["sum"]):
+                self._sum[id(p)] = jnp.asarray(s, jnp.float32)

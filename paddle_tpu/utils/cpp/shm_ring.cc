@@ -41,13 +41,26 @@ struct Ring {
   int fd;
 };
 
+// Each slot = 64B header block (length prefix in the first 8 bytes)
+// followed by a 64B-rounded payload area, and the data region itself
+// starts 64B into the (page-aligned) mapping — so PAYLOADS ARE ALWAYS
+// 64-BYTE ALIGNED. The zero-copy consumer hands payload-resident
+// array bodies to jax, whose CPU client only zero-copies sufficiently
+// aligned buffers; producers align array bodies relative to the
+// payload base, which is only meaningful because of this guarantee.
+constexpr uint64_t kSlotHdr = 64;
+constexpr uint64_t kDataOff = 64;
+
+inline uint64_t slot_stride(uint64_t slot_bytes) {
+  return kSlotHdr + ((slot_bytes + 63) & ~uint64_t(63));
+}
+
 inline uint8_t* slot_ptr(Ring* r, uint64_t idx) {
-  uint64_t stride = 8 + r->hdr->slot_bytes;
-  return r->data + (idx % r->hdr->slots) * stride;
+  return r->data + (idx % r->hdr->slots) * slot_stride(r->hdr->slot_bytes);
 }
 
 size_t total_bytes(uint64_t slots, uint64_t slot_bytes) {
-  return sizeof(RingHeader) + slots * (8 + slot_bytes);
+  return kDataOff + slots * slot_stride(slot_bytes);
 }
 
 }  // namespace
@@ -73,7 +86,7 @@ void* ring_open(const char* name, uint64_t slots, uint64_t slot_bytes,
   }
   Ring* r = new Ring();
   r->hdr = reinterpret_cast<RingHeader*>(mem);
-  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->data = reinterpret_cast<uint8_t*>(mem) + kDataOff;
   r->map_bytes = bytes;
   r->fd = fd;
   if (create) {
@@ -97,7 +110,7 @@ int ring_push(void* handle, const uint8_t* buf, uint64_t len,
     if (head - tail < r->hdr->slots) {
       uint8_t* p = slot_ptr(r, head);
       std::memcpy(p, &len, 8);
-      std::memcpy(p + 8, buf, len);
+      std::memcpy(p + kSlotHdr, buf, len);
       r->hdr->head.store(head + 1, std::memory_order_release);
       return 0;
     }
@@ -120,7 +133,7 @@ int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
       uint64_t len;
       std::memcpy(&len, p, 8);
       if (len > buf_len) return -2;
-      std::memcpy(buf, p + 8, len);
+      std::memcpy(buf, p + kSlotHdr, len);
       r->hdr->tail.store(tail + 1, std::memory_order_release);
       return (int64_t)len;
     }
@@ -149,7 +162,7 @@ uint8_t* ring_push_reserve(void* handle, int64_t timeout_ms) {
   for (;;) {
     uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
     uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
-    if (head - tail < r->hdr->slots) return slot_ptr(r, head) + 8;
+    if (head - tail < r->hdr->slots) return slot_ptr(r, head) + kSlotHdr;
     if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
     usleep(200);
     waited_us += 200;
@@ -179,7 +192,7 @@ uint8_t* ring_pop_view(void* handle, uint64_t* len_out,
     if (tail < head) {
       uint8_t* p = slot_ptr(r, tail);
       std::memcpy(len_out, p, 8);
-      return p + 8;
+      return p + kSlotHdr;
     }
     if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
     usleep(200);

@@ -1,0 +1,117 @@
+"""Worker for LocalSGD meta-optimizer tests (2 ranks, eager DP).
+
+Three phases, each from identical seeds with rank-sharded data:
+  1. sync DP reference: allreduce grads every step, SGD update
+  2. LocalSGD k=1: local SGD step + delta-average every step —
+     must produce EXACTLY the sync-DP parameters (plain SGD commutes
+     with averaging)
+  3. LocalSGD k=4 over 8 steps: replicas must AGREE after the final
+     communication and the shared loss must have decreased
+  4. AdaptiveLocalSGD: runs, adapts k, converges
+Writes observations as JSON per rank.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as optim  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: E402
+    AdaptiveLocalSGDOptimizer, LocalSGDOptimizer)
+
+
+def make_model():
+    paddle.seed(7)
+    return nn.Linear(8, 4)
+
+
+def shard(rank):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 8).astype(np.float32)
+    ys = rng.randn(8, 4).astype(np.float32)
+    return xs[rank::2], ys[rank::2]
+
+
+def loss_of(model, x, y):
+    pred = model(paddle.to_tensor(x))
+    return paddle.mean((pred - paddle.to_tensor(y)) ** 2)
+
+
+def train_sync_dp(rank, steps=4):
+    model = make_model()
+    opt = optim.SGD(learning_rate=0.1, parameters=model.parameters())
+    x, y = shard(rank)
+    world = dist.get_world_size()
+    for _ in range(steps):
+        loss = loss_of(model, x, y)
+        loss.backward()
+        for p in model.parameters():
+            g = p.grad
+            dist.all_reduce(g)
+            p._grad = g / float(world)
+        opt.step()
+        opt.clear_grad()
+    return [p.numpy().tolist() for p in model.parameters()]
+
+
+def train_localsgd(rank, k, steps):
+    model = make_model()
+    opt = LocalSGDOptimizer(
+        optim.SGD(learning_rate=0.1, parameters=model.parameters()),
+        k_steps=k, begin_step=0)
+    x, y = shard(rank)
+    losses = []
+    for _ in range(steps):
+        loss = loss_of(model, x, y)
+        losses.append(float(loss.item()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return [p.numpy().tolist() for p in model.parameters()], losses
+
+
+def train_adaptive(rank, steps=8):
+    model = make_model()
+    opt = AdaptiveLocalSGDOptimizer(
+        optim.SGD(learning_rate=0.1, parameters=model.parameters()),
+        init_k_steps=2, begin_step=0)
+    x, y = shard(rank)
+    ks = []
+    losses = []
+    for _ in range(steps):
+        loss = loss_of(model, x, y)
+        losses.append(float(loss.item()))
+        loss.backward()
+        opt.step(loss)
+        opt.clear_grad()
+        ks.append(opt.k_steps)
+    return ks, losses
+
+
+def main(out_prefix):
+    rank = dist.get_rank()
+    dist.init_parallel_env()
+    out = {}
+    out["sync_dp"] = train_sync_dp(rank)
+    p1, _ = train_localsgd(rank, k=1, steps=4)
+    out["localsgd_k1"] = p1
+    p4, losses4 = train_localsgd(rank, k=4, steps=8)
+    out["localsgd_k4"] = p4
+    out["localsgd_k4_losses"] = losses4
+    ks, lossesA = train_adaptive(rank)
+    out["adaptive_ks"] = ks
+    out["adaptive_losses"] = lossesA
+    with open(f"{out_prefix}.rank{rank}", "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,196 @@
+"""EMA / LookAhead / ModelAverage tests (r4 verdict missing #4) —
+numpy-referenced updates + state_dict round-trips.
+
+Reference semantics: fluid/optimizer.py ExponentialMovingAverage,
+incubate/optimizer/lookahead.py, incubate/optimizer/modelaverage.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+
+
+def _mk(seed=3):
+    paddle.seed(seed)
+    return nn.Linear(4, 3)
+
+
+def _train_step(model, opt_or_cb, x, y):
+    pred = model(paddle.to_tensor(x))
+    loss = paddle.mean((pred - paddle.to_tensor(y)) ** 2)
+    loss.backward()
+    if callable(getattr(opt_or_cb, "step", None)):
+        opt_or_cb.step()
+        opt_or_cb.clear_grad()
+    return float(loss.item())
+
+
+def test_ema_matches_numpy_reference():
+    model = _mk()
+    opt = optim.SGD(learning_rate=0.05,
+                    parameters=model.parameters())
+    decay = 0.9
+    ema = optim.ExponentialMovingAverage(model.parameters(),
+                                         decay=decay)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 3).astype(np.float32)
+    shadows = [np.zeros_like(np.asarray(p._value), np.float32)
+               for p in model.parameters()]
+    T = 5
+    for t in range(T):
+        _train_step(model, opt, x, y)
+        ema.update()
+        for i, p in enumerate(model.parameters()):
+            shadows[i] = decay * shadows[i] + (1 - decay) * np.asarray(
+                p._value, np.float32)
+    raw = [np.asarray(p._value).copy() for p in model.parameters()]
+    with ema.apply():
+        corr = 1.0 - decay ** T  # bias correction (reference eq.)
+        for p, s in zip(model.parameters(), shadows):
+            np.testing.assert_allclose(np.asarray(p._value), s / corr,
+                                       rtol=1e-5, atol=1e-6)
+    for p, r in zip(model.parameters(), raw):  # restored
+        np.testing.assert_allclose(np.asarray(p._value), r, rtol=0,
+                                   atol=0)
+
+
+def test_ema_thres_steps_schedules_decay():
+    model = _mk()
+    ema = optim.ExponentialMovingAverage(model.parameters(), decay=0.999,
+                                         thres_steps=lambda: 0.0)
+    # min(0.999, (1+0)/(10+0)) = 0.1
+    assert abs(ema._decay_t() - 0.1) < 1e-9
+
+
+def test_ema_state_dict_roundtrip():
+    model = _mk()
+    ema = optim.ExponentialMovingAverage(model.parameters(), decay=0.9)
+    for p in model.parameters():
+        p._grad = None
+    ema.update()
+    sd = ema.state_dict()
+    ema2 = optim.ExponentialMovingAverage(model.parameters(), decay=0.9)
+    ema2.set_state_dict(sd)
+    for p in model.parameters():
+        np.testing.assert_allclose(np.asarray(ema2._shadow[id(p)]),
+                                   np.asarray(ema._shadow[id(p)]))
+    assert ema2._t == ema._t
+
+
+def test_lookahead_matches_numpy_reference():
+    model = _mk()
+    inner = optim.SGD(learning_rate=0.1, parameters=model.parameters())
+    alpha, k = 0.5, 2
+    la = optim.LookAhead(inner, alpha=alpha, k=k)
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 3).astype(np.float32)
+
+    # numpy mirror of fast/slow dynamics with plain SGD
+    slow = [np.asarray(p._value, np.float32).copy()
+            for p in model.parameters()]
+    fast = [s.copy() for s in slow]
+
+    def np_grads(ws):
+        # linear layer: pred = x@W + b; loss = mean((pred-y)^2)
+        W, b = ws
+        pred = x @ W + b
+        g = 2.0 * (pred - y) / pred.size
+        return [x.T @ g, g.sum(0)]
+
+    for t in range(1, 5):
+        gW, gb = np_grads(fast)
+        fast[0] = fast[0] - 0.1 * gW
+        fast[1] = fast[1] - 0.1 * gb
+        if t % k == 0:
+            for i in range(2):
+                slow[i] = slow[i] + alpha * (fast[i] - slow[i])
+                fast[i] = slow[i].copy()
+        _train_step(model, la, x, y)
+        for p, f in zip(model.parameters(), fast):
+            np.testing.assert_allclose(np.asarray(p._value), f,
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_lookahead_state_dict_roundtrip():
+    model = _mk()
+    la = optim.LookAhead(
+        optim.Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=model.parameters()), alpha=0.3, k=3)
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    for _ in range(4):
+        _train_step(model, la, x, y)
+    sd = la.state_dict()
+    la2 = optim.LookAhead(
+        optim.Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=model.parameters()), alpha=0.9, k=7)
+    la2.set_state_dict(sd)
+    assert la2.alpha == 0.3 and la2.k == 3 and la2._la_step == 4
+    for p in model.parameters():
+        np.testing.assert_allclose(np.asarray(la2._slow[id(p)]),
+                                   np.asarray(la._slow[id(p)]))
+
+
+def test_model_average_matches_numpy_reference():
+    model = _mk()
+    inner = optim.SGD(learning_rate=0.05,
+                      parameters=model.parameters())
+    ma = optim.ModelAverage(0.5, parameters=model.parameters(),
+                            min_average_window=2,
+                            max_average_window=10,
+                            inner_optimizer=inner)
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 3).astype(np.float32)
+    history = []
+    for _ in range(3):
+        _train_step(model, ma, x, y)
+        history.append([np.asarray(p._value, np.float32).copy()
+                        for p in model.parameters()])
+    # window: num_accumulates restarts per the reference condition —
+    # replicate it
+    sums = [np.zeros_like(h) for h in history[0]]
+    num_acc = 0
+    for t, snap in enumerate(history, start=1):
+        num_acc += 1
+        for i, arr in enumerate(snap):
+            sums[i] = sums[i] + arr
+        limit = min(10, max(int(t * 0.5), 1))
+        if num_acc >= 2 and num_acc >= limit:
+            num_acc = 1
+            sums = [arr.copy() for arr in snap]
+    raw = [np.asarray(p._value).copy() for p in model.parameters()]
+    with ma.apply():
+        for p, s in zip(model.parameters(), sums):
+            np.testing.assert_allclose(np.asarray(p._value),
+                                       s / max(num_acc, 1),
+                                       rtol=1e-5, atol=1e-6)
+    for p, r in zip(model.parameters(), raw):
+        np.testing.assert_allclose(np.asarray(p._value), r)
+
+
+def test_model_average_state_dict_roundtrip():
+    model = _mk()
+    ma = optim.ModelAverage(0.5, parameters=model.parameters(),
+                            min_average_window=2, max_average_window=10)
+    ma.accumulate()
+    sd = ma.state_dict()
+    ma2 = optim.ModelAverage(0.5, parameters=model.parameters(),
+                             min_average_window=2, max_average_window=10)
+    ma2.set_state_dict(sd)
+    assert ma2._num_accumulates == ma._num_accumulates
+    for p in model.parameters():
+        np.testing.assert_allclose(np.asarray(ma2._sum[id(p)]),
+                                   np.asarray(ma._sum[id(p)]))
+
+
+def test_incubate_exports():
+    import paddle_tpu.incubate as incubate
+
+    assert incubate.LookAhead is optim.LookAhead
+    assert incubate.optimizer.ModelAverage is optim.ModelAverage
