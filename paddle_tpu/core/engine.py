@@ -49,6 +49,10 @@ class _State(threading.local):
     def __init__(self):
         self.grad_enabled = True
         self.trace_mode = 0  # >0 when tracing for jit/to_static
+        self.trace_tape = 0  # >0: record the tape DURING tracing, so
+        # paddle.grad works inside a to_static function (reference
+        # grad_transformer). Off by default — trace-time vjp recording
+        # would slow every compile for a capability few traces use.
         self.seq = 0
 
 
@@ -104,6 +108,21 @@ def register_trace_exit_hook(fn):
     exception) — used to drop trace-scoped state (e.g. pending p2p
     sends) so tracers never leak across traces."""
     _trace_exit_hooks.append(fn)
+
+
+class trace_tape:
+    """Record the autograd tape while tracing (grad-inside-to_static:
+    the tape's vjp closures hold tracers, which is valid within one
+    trace). Entered by StaticFunction for functions whose source calls
+    grad()."""
+
+    def __enter__(self):
+        _state.trace_tape += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_tape -= 1
+        return False
 
 
 class trace_mode:
@@ -282,14 +301,15 @@ def apply_op(name, fn, *args, **kwargs):
     if _input_cast_hook is not None:
         uargs = _input_cast_hook(name, uargs)
 
-    if in_trace_mode():
+    if in_trace_mode() and not _state.trace_tape:
         out_vals = fn(*uargs, **kwargs)
         requires = _state.grad_enabled and any(
             _is_tensor(t) and not t.stop_gradient for t in flat_in
         )
         return _wrap_outputs(out_vals, requires, node=None)
 
-    requires = is_grad_enabled() and any(
+    requires = (is_grad_enabled() or _state.trace_tape > 0) and \
+        _state.grad_enabled and any(
         _is_tensor(t) and not t.stop_gradient for t in flat_in
     )
 

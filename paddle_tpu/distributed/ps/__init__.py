@@ -401,6 +401,7 @@ class PSServer:
         self.server_id = server_id
         self._dense = {}
         self._sparse = {}
+        self._graph = {}
         self._barrier_count = {}
         self._barrier_lock = threading.Lock()
 
@@ -451,6 +452,42 @@ class PSServer:
             self._sparse[req["table"]].apply_delta(req["ids"],
                                                    req["deltas"])
             return {"ok": True}
+        # -- graph table RPCs (reference graph_brpc_server.cc) --------
+        if op == "graph_create":
+            from .graph import GraphTable
+
+            self._graph[req["table"]] = GraphTable(req.get("feat_dim",
+                                                           0))
+            if req.get("seed") is not None:
+                self._graph[req["table"]].seed(
+                    int(req["seed"]) + self.server_id)
+            return {"ok": True}
+        if op == "graph_add_edges":
+            self._graph[req["table"]].add_edges(req["srcs"], req["dsts"],
+                                                req.get("weights"))
+            return {"ok": True}
+        if op == "graph_add_nodes":
+            self._graph[req["table"]].add_nodes(req["ids"],
+                                                req.get("feats"))
+            return {"ok": True}
+        if op == "graph_sample":
+            n, w = self._graph[req["table"]].sample_neighbors(
+                req["ids"], req["k"])
+            return {"ok": True, "value": (n, w)}
+        if op == "graph_random_nodes":
+            return {"ok": True,
+                    "value": self._graph[req["table"]]
+                    .random_nodes(req["n"], req.get("mod"),
+                                  self.server_id)}
+        if op == "graph_node_feat":
+            return {"ok": True,
+                    "value": self._graph[req["table"]]
+                    .node_feat(req["ids"])}
+        if op == "graph_size":
+            return {"ok": True, "value": {
+                "nodes": self._graph[req["table"]].size(
+                    req.get("mod"), self.server_id),
+                "edges": self._graph[req["table"]].edge_count()}}
         if op == "sparse_stats":
             tbl = self._sparse[req["table"]]
             stats = (tbl.spill_stats() if hasattr(tbl, "spill_stats")
@@ -637,6 +674,96 @@ class PSClient:
     def sparse_size(self, table):
         return sum(self._call(s, {"op": "sparse_size", "table": table})
                    for s in range(self.num_servers))
+
+    # -- graph table API (reference graph_brpc_client.cc) -------------
+    def create_graph_table(self, table, feat_dim=0, seed=None):
+        for s in range(self.num_servers):
+            self._call(s, {"op": "graph_create", "table": table,
+                           "feat_dim": feat_dim, "seed": seed})
+
+    def add_graph_edges(self, table, srcs, dsts, weights=None):
+        """Edges shard to their SOURCE node's server."""
+        srcs = np.asarray(srcs, np.int64).ravel()
+        dsts = np.asarray(dsts, np.int64).ravel()
+        weights = (None if weights is None
+                   else np.asarray(weights, np.float32).ravel())
+        srv = srcs % self.num_servers
+        for s in range(self.num_servers):
+            idx = np.nonzero(srv == s)[0]
+            if len(idx) == 0:
+                continue
+            self._call(s, {"op": "graph_add_edges", "table": table,
+                           "srcs": srcs[idx], "dsts": dsts[idx],
+                           "weights": (None if weights is None
+                                       else weights[idx])})
+
+    def add_graph_nodes(self, table, ids, feats=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        feats = (None if feats is None
+                 else np.asarray(feats, np.float32))
+        srv = ids % self.num_servers
+        for s in range(self.num_servers):
+            idx = np.nonzero(srv == s)[0]
+            if len(idx) == 0:
+                continue
+            self._call(s, {"op": "graph_add_nodes", "table": table,
+                           "ids": ids[idx],
+                           "feats": (None if feats is None
+                                     else feats[idx])})
+
+    def sample_neighbors(self, table, ids, k):
+        """Per id: up to k weighted-sampled neighbors. Returns
+        (neighbors, weights): lists of arrays aligned with ids."""
+        ids = np.asarray(ids, np.int64).ravel()
+        srv = ids % self.num_servers
+        neigh = [None] * len(ids)
+        wts = [None] * len(ids)
+        for s in range(self.num_servers):
+            idx = np.nonzero(srv == s)[0]
+            if len(idx) == 0:
+                continue
+            n, w = self._call(s, {"op": "graph_sample", "table": table,
+                                  "ids": ids[idx], "k": int(k)})
+            for i, nn, ww in zip(idx, n, w):
+                neigh[i] = nn
+                wts[i] = ww
+        return neigh, wts
+
+    def random_sample_nodes(self, table, n):
+        """~n node ids sampled across shards (batch seeding for GNN
+        walks)."""
+        per = max(1, n // self.num_servers)
+        parts = [self._call(s, {"op": "graph_random_nodes",
+                                "table": table, "n": per,
+                                "mod": self.num_servers})
+                 for s in range(self.num_servers)]
+        parts = [p for p in parts if len(p)]
+        out = (np.concatenate(parts) if parts
+               else np.empty(0, np.int64))
+        return out[:n]
+
+    def get_node_feat(self, table, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        srv = ids % self.num_servers
+        rows = [None] * len(ids)
+        for s in range(self.num_servers):
+            idx = np.nonzero(srv == s)[0]
+            if len(idx) == 0:
+                continue
+            feats = self._call(s, {"op": "graph_node_feat",
+                                   "table": table, "ids": ids[idx]})
+            for i, f in zip(idx, feats):
+                rows[i] = f
+        return np.stack(rows) if rows else rows
+
+    def graph_size(self, table):
+        tot = {"nodes": 0, "edges": 0}
+        for s in range(self.num_servers):
+            sz = self._call(s, {"op": "graph_size", "table": table,
+                                "mod": self.num_servers})
+            tot["nodes"] += sz["nodes"]
+            tot["edges"] += sz["edges"]
+        return tot
 
     def sparse_stats(self, table):
         """Aggregated spill/residency stats across shards."""

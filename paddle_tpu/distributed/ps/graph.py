@@ -1,0 +1,145 @@
+"""PS graph tables + neighbor sampling (r4 verdict missing #2).
+
+Parity target: paddle/fluid/distributed/ps/table/common_graph_table.cc
+(GraphTable: nodes with float features, weighted adjacency, random
+neighbor sampling, random node batches) and graph_brpc_server.cc (the
+sampling RPC surface used by GNN workloads: the trainer pulls sampled
+sub-graphs batch by batch instead of materializing the graph).
+
+TPU-native design: the graph shards across PS servers by node id
+(edges live on their SOURCE node's shard, features on the node's
+shard) — same partitioning as the reference's shard_num buckets. The
+server samples with numpy (weighted, without replacement, truncating
+to degree like the reference's actual_size) so only the sampled ids
+cross the wire; the trainer assembles device-ready index arrays.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["GraphTable"]
+
+
+class GraphTable:
+    """One shard's adjacency + node features."""
+
+    def __init__(self, feat_dim=0):
+        self.feat_dim = int(feat_dim)
+        self._adj = {}      # src -> (np int64 dsts, np float32 weights)
+        self._feat = {}     # node -> np float32 [feat_dim]
+        self._nodes = set()
+        self._lock = threading.Lock()
+        self._rng = np.random.RandomState(0)
+
+    def seed(self, s):
+        self._rng = np.random.RandomState(int(s))
+
+    def add_edges(self, srcs, dsts, weights=None):
+        srcs = np.asarray(srcs, np.int64).ravel()
+        dsts = np.asarray(dsts, np.int64).ravel()
+        if weights is None:
+            weights = np.ones(len(srcs), np.float32)
+        weights = np.asarray(weights, np.float32).ravel()
+        with self._lock:
+            for s, d, w in zip(srcs, dsts, weights):
+                s = int(s)
+                old = self._adj.get(s)
+                if old is None:
+                    self._adj[s] = (np.asarray([d], np.int64),
+                                    np.asarray([w], np.float32))
+                else:
+                    self._adj[s] = (np.append(old[0], d),
+                                    np.append(old[1], w))
+                self._nodes.add(s)
+                self._nodes.add(int(d))
+
+    def add_nodes(self, ids, feats=None):
+        ids = np.asarray(ids, np.int64).ravel()
+        with self._lock:
+            for k, i in enumerate(ids):
+                i = int(i)
+                self._nodes.add(i)
+                if feats is not None:
+                    self._feat[i] = np.asarray(feats[k], np.float32)
+
+    def degree(self, ids):
+        with self._lock:
+            return [len(self._adj.get(int(i), ((), ()))[0])
+                    for i in ids]
+
+    def sample_neighbors(self, ids, k):
+        """Per id: up to k neighbors, weighted sampling WITHOUT
+        replacement; degree <= k returns the full neighborhood
+        (reference actual_size semantics). Returns (neighbors list of
+        int64 arrays, weights list of float32 arrays)."""
+        out_n, out_w = [], []
+        with self._lock:
+            for i in ids:
+                ent = self._adj.get(int(i))
+                if ent is None:
+                    out_n.append(np.empty(0, np.int64))
+                    out_w.append(np.empty(0, np.float32))
+                    continue
+                dsts, w = ent
+                if len(dsts) <= k:
+                    out_n.append(dsts.copy())
+                    out_w.append(w.copy())
+                else:
+                    p = w / w.sum()
+                    sel = self._rng.choice(len(dsts), size=k,
+                                           replace=False, p=p)
+                    out_n.append(dsts[sel])
+                    out_w.append(w[sel])
+        return out_n, out_w
+
+    def random_nodes(self, n, mod=None, sid=None):
+        """Random OWNED nodes: a shard also knows foreign dst nodes
+        from its edges, and sampling those would duplicate ids across
+        shards (review r5 — same ownership rule as size())."""
+        with self._lock:
+            src = (self._nodes if mod is None
+                   else [x for x in self._nodes if x % mod == sid])
+            pool = np.asarray(sorted(src), np.int64)
+        if len(pool) == 0:
+            return np.empty(0, np.int64)
+        sel = self._rng.choice(len(pool), size=min(n, len(pool)),
+                               replace=False)
+        return pool[sel]
+
+    def node_feat(self, ids):
+        with self._lock:
+            dim = self.feat_dim
+            return np.stack([
+                self._feat.get(int(i), np.zeros(dim, np.float32))
+                for i in ids]) if len(ids) else np.empty((0, dim),
+                                                         np.float32)
+
+    def size(self, mod=None, sid=None):
+        """Node count; with (mod, sid) only nodes OWNED by shard sid
+        (a dst node is known to its src's shard too — summing raw
+        counts across shards would double-count it)."""
+        with self._lock:
+            if mod is None:
+                return len(self._nodes)
+            return sum(1 for n in self._nodes if n % mod == sid)
+
+    def edge_count(self):
+        with self._lock:
+            return sum(len(d) for d, _ in self._adj.values())
+
+    # -- persistence (save/load piggyback on the PS snapshot) ---------
+    def state(self):
+        with self._lock:
+            return {"feat_dim": self.feat_dim, "adj": dict(self._adj),
+                    "feat": dict(self._feat),
+                    "nodes": sorted(self._nodes)}
+
+    @classmethod
+    def from_state(cls, st):
+        t = cls(st["feat_dim"])
+        t._adj = dict(st["adj"])
+        t._feat = dict(st["feat"])
+        t._nodes = set(st["nodes"])
+        return t

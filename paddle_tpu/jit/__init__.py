@@ -121,6 +121,9 @@ def _freeze_static(v):
         return ("id", id(v))
 
 
+from .dy2static import source_calls_grad as _source_calls_grad  # noqa: E402
+
+
 class StaticFunction:
     """Compiled wrapper (reference: StaticFunction,
     program_translator.py:236)."""
@@ -138,6 +141,10 @@ class StaticFunction:
         # still transforms so conversion reaches its CALLEES (reference
         # convert_call_func.py recursion — r4)
         self._trace_target = ast_transform(func, for_call=True) or func
+        # grad-inside-to_static (reference grad_transformer): tape
+        # recording during tracing is opt-in per function — detected
+        # from the source so ordinary traces don't pay the vjp cost
+        self._needs_tape = _source_calls_grad(func)
         self._input_spec = input_spec
         self._compiled = {}
         functools.update_wrapper(self, func,
@@ -152,6 +159,7 @@ class StaticFunction:
             if self._trace_target is not self._func else bound._func
         bound._input_spec = self._input_spec
         bound._compiled = self._compiled
+        bound._needs_tape = self._needs_tape
         functools.update_wrapper(bound, bound._func,
                                  assigned=("__name__", "__doc__"))
         return bound
@@ -180,9 +188,15 @@ class StaticFunction:
 
         from .dy2static import max_loop_iterations
 
+        # stop_gradient travels into the trace: paddle.grad INSIDE a
+        # to_static function (reference grad_transformer) needs the
+        # differentiable args to record tape edges; it changes the
+        # traced program, so it joins the cache key
+        arg_sg = tuple(bool(flat_args[i].stop_gradient)
+                       for i in tensor_pos)
         key = (args_treedef, tuple(tensor_pos),
                tuple((tuple(flat_args[i].shape), str(flat_args[i].dtype))
-                     for i in tensor_pos), tuple(param_ids),
+                     for i in tensor_pos), tuple(param_ids), arg_sg,
                tuple(_freeze_static(v) for v in static_leaves),
                # the loop bound changes the lowering (while_loop vs
                # bounded scan) — it must participate in the cache key
@@ -192,7 +206,7 @@ class StaticFunction:
         entry = self._compiled.get(key)
         if entry is None:
             entry = self._build(target, params, args_treedef, tensor_pos,
-                                static_leaves)
+                                static_leaves, arg_sg)
             self._compiled[key] = entry
         jfn, box = entry
         arg_ts = [flat_args[i] for i in tensor_pos]
@@ -227,12 +241,16 @@ class StaticFunction:
         return tree_util.tree_unflatten(box["treedef"], flat_out)
 
     def _build(self, target, params, args_treedef, tensor_pos,
-               static_leaves):
+               static_leaves, arg_sg=None):
         box = {}
+        import contextlib
+
+        tape_ctx = (engine.trace_tape if self._needs_tape
+                    else contextlib.nullcontext)
 
         @jax.jit
         def jfn(pvals, avals, rng_counter):
-            with engine.trace_mode():
+            with engine.trace_mode(), tape_ctx():
                 prev_key = _random.push_traced_key(
                     jax.random.fold_in(_random._rng.base, rng_counter))
                 try:
@@ -241,7 +259,8 @@ class StaticFunction:
                         p._value = v
                     leaves = list(static_leaves)
                     for i, pos in enumerate(tensor_pos):
-                        leaves[pos] = Tensor(avals[i], stop_gradient=True,
+                        sg = True if arg_sg is None else arg_sg[i]
+                        leaves[pos] = Tensor(avals[i], stop_gradient=sg,
                                              _internal=True)
                     args, kwargs = tree_util.tree_unflatten(args_treedef,
                                                             leaves)

@@ -433,6 +433,53 @@ def finalize_ret(rf, rv):
     return rv
 
 
+def convert_assert(cond, msg=None):
+    """assert transform (reference assert_transformer.py: `assert c`
+    becomes an Assert op that halts at RUN time): concrete conditions
+    keep Python semantics; traced conditions check on device via a
+    debug callback that raises AssertionError when false."""
+    c = _unwrap(cond)
+    if _is_traced(c):
+        def _check(ok):
+            if not bool(ok):
+                raise AssertionError(
+                    msg if msg is not None else
+                    "dy2static: traced assert failed at run time")
+
+        jax.debug.callback(
+            _check, jnp.reshape(jnp.asarray(c), ()).astype(bool))
+        return None
+    if not _truthy(c):
+        raise AssertionError(
+            msg if msg is not None else "assert failed")
+    return None
+
+
+_CAST_TARGETS = {"int": "int32", "float": "float32", "bool": "bool"}
+
+
+def convert_cast(x, ty):
+    """int(x)/float(x)/bool(x) transform (reference
+    cast_transformer.py: builtin casts on Variables become cast ops):
+    traced tensors return a CAST TENSOR (static-graph semantics — the
+    value stays on device); concrete values use the Python builtin.
+    int() maps to int32 — the declared index dtype policy
+    (core/dtype.py convert_dtype)."""
+    v = _unwrap(x)
+    if _is_traced(v):
+        from ..core.dtype import index_dtype
+
+        tgt = (index_dtype() if ty == "int"
+               else jnp.dtype(_CAST_TARGETS[ty]))
+        av = jnp.asarray(v)
+        if ty == "int":
+            # Python int() truncates toward zero
+            av = jnp.trunc(av) if jnp.issubdtype(av.dtype,
+                                                 jnp.floating) else av
+        return _wrap(av.astype(tgt))
+    return {"int": int, "float": float, "bool": bool}[ty](v)
+
+
 def convert_print(*args, **kwargs):
     """print transform (reference print_transformer.py): traced tensor
     arguments print at RUN time via jax.debug.print (the reference
@@ -651,6 +698,37 @@ _convert_call_cache: "weakref.WeakKeyDictionary" = \
     weakref.WeakKeyDictionary()
 
 
+def source_calls_grad(fn):
+    """Heuristic: does the function's source (textually) call grad()?
+    Used to turn on trace-time tape recording for grad-inside-
+    to_static (reference grad_transformer applies per converted
+    function). False positives only cost trace-time tape overhead."""
+    import re
+
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        return False
+    return bool(re.search(r"\bgrad\s*\(", src))
+
+
+def _tape_wrap(fn):
+    """Enter trace_tape around the call when tracing: a CALLEE that
+    uses grad() needs the tape on even though the top-level function's
+    source never mentions grad (review r5)."""
+    @functools.wraps(fn)
+    def w(*a, **kw):
+        from ..core import engine
+
+        if engine.in_trace_mode():
+            with engine.trace_tape():
+                return fn(*a, **kw)
+        return fn(*a, **kw)
+
+    w.__jst_converted__ = True
+    return w
+
+
 def convert_call(fn):
     """Runtime-lazy recursive conversion of callees (reference
     convert_call_func.py convert_call): user functions and methods get
@@ -701,8 +779,11 @@ def convert_call(fn):
                 new.__jst_converted__ = True
             except AttributeError:
                 pass
-        _convert_call_cache[fn] = new
-        return new or fn
+        result = new or fn
+        if source_calls_grad(fn):
+            result = _tape_wrap(result)
+        _convert_call_cache[fn] = result if result is not fn else new
+        return result
     except Exception:
         return fn
 
@@ -1051,6 +1132,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return self._jst_call("convert_shape", [node.value])
         return node
 
+    def visit_Assert(self, node):
+        """assert transform (reference assert_transformer.py): the
+        test routes through convert_assert so a traced condition
+        checks at RUN time instead of crashing on bool(tracer)."""
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            args.append(node.msg)
+        return ast.Expr(value=self._jst_call("convert_assert", args))
+
     def visit_If(self, node):
         # liveness BEFORE transforming children (the rewrite introduces
         # loads of every threaded name)
@@ -1158,6 +1249,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     value=ast.Name(id="_jst", ctx=ast.Load()),
                     attr=conv[node.func.id], ctx=ast.Load()),
                 args=node.args, keywords=[])
+        # builtin casts (reference cast_transformer.py): int(x)/
+        # float(x)/bool(x) on a traced tensor become cast ops
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float", "bool")
+                and len(node.args) == 1 and not node.keywords):
+            return self._jst_call(
+                "convert_cast",
+                [node.args[0], ast.Constant(value=node.func.id)])
         fn = node.func
         if isinstance(fn, ast.Name) and fn.id in self._NO_WRAP_CALLS:
             return node

@@ -19,6 +19,7 @@ from ...core.tensor import Tensor
 from ...ops import creation as C
 from ...ops import manipulation as M
 from .layers import Layer
+from ...core.dtype import index_dtype as _index_dtype
 
 __all__ = ["BeamSearchDecoder", "dynamic_decode"]
 
@@ -92,7 +93,7 @@ class BeamSearchDecoder:
 
             top_lp, top_idx = jax.lax.top_k(cand, K)
             parent = (top_idx // V).astype(jnp.int32)  # [B, K]
-            tok = (top_idx % V).astype(jnp.int64)
+            tok = (top_idx % V).astype(_index_dtype())
             B = cand.shape[0]
             flat_parent = (jnp.arange(B, dtype=jnp.int32)[:, None] * K
                            + parent).reshape(-1)
@@ -185,7 +186,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
             lambda s, e: jnp.argmax(
                 jnp.concatenate([(s == e), jnp.ones_like(s[..., :1],
                                                          dtype=bool)],
-                                axis=-1), axis=-1).astype(jnp.int64),
+                                axis=-1), axis=-1).astype(_index_dtype()),
             seqs, e=decoder.end_token)
         return (seqs, scores), states, lengths
     return (seqs, scores), states

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from ..core.dtype import convert_dtype
 from ..core.engine import apply_op
 from ..core.tensor import Tensor
+from ..core.dtype import index_dtype as _index_dtype
 
 _this = sys.modules[__name__]
 
@@ -316,7 +317,7 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
     return apply_op(
         "count_nonzero",
         lambda v, axis, keepdim: jnp.count_nonzero(v, axis=axis, keepdims=keepdim
-                                                   ).astype(jnp.int64),
+                                                   ).astype(_index_dtype()),
         x, axis=_axes(axis), keepdim=bool(keepdim))
 
 

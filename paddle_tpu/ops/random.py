@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..core.dtype import convert_dtype, default_float_dtype
 from ..core.engine import apply_op, in_trace_mode
 from ..core.tensor import Tensor
+from ..core.dtype import index_dtype as _index_dtype
 
 __all__ = [
     "seed", "get_rng_state", "set_rng_state", "uniform", "uniform_",
@@ -173,7 +174,7 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
         low, high = 0, low
     return _wrap(jax.random.randint(next_key(), tuple(x.shape), low, high,
                                     dtype=dt if jnp.issubdtype(dt, jnp.integer)
-                                    else jnp.int64).astype(dt))
+                                    else _index_dtype()).astype(dt))
 
 
 def randperm(n, dtype="int64", name=None):
@@ -224,7 +225,7 @@ def binomial(count, prob, name=None):
     key = next_key()
 
     def _k(n, p, key):
-        return jax.random.binomial(key, n, p).astype(jnp.int64)
+        return jax.random.binomial(key, n, p).astype(_index_dtype())
 
     return apply_op("binomial", _k, count, prob, key=key)
 

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ..core.dtype import convert_dtype
 from ..core.engine import apply_op
 from ..core.tensor import Tensor
+from ..core.dtype import index_dtype as _index_dtype
 
 __all__ = [
     "argmax", "argmin", "argsort", "sort", "topk", "unique",
@@ -46,7 +47,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def _k_argsort(x, axis, descending, stable):
     out = jnp.argsort(x, axis=axis, stable=stable,
                       descending=descending)
-    return out.astype(jnp.int64)
+    return out.astype(_index_dtype())
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
@@ -72,7 +73,7 @@ def _k_topk(x, k, axis, largest, sorted_):
     else:
         vals, idx = jax.lax.top_k(-moved, k)
         vals = -vals
-    return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(_index_dtype()))
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
@@ -127,7 +128,7 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
 
 def _k_searchsorted(sorted_sequence, values, right):
     return jnp.searchsorted(sorted_sequence, values,
-                            side="right" if right else "left").astype(jnp.int64)
+                            side="right" if right else "left").astype(_index_dtype())
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,
@@ -146,7 +147,7 @@ def _k_kthvalue(x, k, axis, keepdim):
     ax = axis % nd
     moved = jnp.moveaxis(x, ax, -1)
     vals = jnp.sort(moved, axis=-1)[..., k - 1]
-    idx = jnp.argsort(moved, axis=-1)[..., k - 1].astype(jnp.int64)
+    idx = jnp.argsort(moved, axis=-1)[..., k - 1].astype(_index_dtype())
     if keepdim:
         vals = jnp.expand_dims(vals, ax)
         idx = jnp.expand_dims(idx, ax)
@@ -183,7 +184,7 @@ def _k_mode(x, axis, keepdim):
     vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
     # index of the mode value in the original array (first occurrence)
     match = moved == vals[..., None]
-    idx = jnp.argmax(match, axis=-1).astype(jnp.int64)
+    idx = jnp.argmax(match, axis=-1).astype(_index_dtype())
     if keepdim:
         vals = jnp.expand_dims(vals, ax)
         idx = jnp.expand_dims(idx, ax)

@@ -1,0 +1,22 @@
+"""Subprocess PS shard for the graph-table test: hosts one PSServer
+on the given port until stdin closes."""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed.ps import PSServer  # noqa: E402
+
+
+def main():
+    port = int(sys.argv[1])
+    sid = int(sys.argv[2])
+    srv = PSServer(port=port, server_id=sid)
+    print(f"READY {srv.endpoint}", flush=True)
+    sys.stdin.read()  # parent closes stdin to stop us
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

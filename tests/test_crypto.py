@@ -1,0 +1,76 @@
+"""Model crypto tests (r4 verdict missing #6). Reference:
+paddle/fluid/pybind/crypto.cc + framework/io/crypto/aes_cipher_test.cc."""
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.crypto import AESCipher, CipherFactory, CipherUtils
+
+
+def test_ctr_roundtrip_bytes_and_file(tmp_path):
+    key = CipherUtils.gen_key(256)
+    c = AESCipher("AES_CTR_NoPadding")
+    msg = b"model bytes \x00\x01\x02" * 100
+    ct = c.encrypt(msg, key)
+    assert ct != msg and len(ct) == len(msg) + 16  # IV || body
+    assert c.decrypt(ct, key) == msg
+    # fresh IV per encryption
+    assert c.encrypt(msg, key) != ct
+    p = tmp_path / "enc.bin"
+    c.encrypt_to_file(msg, key, str(p))
+    assert c.decrypt_from_file(key, str(p)) == msg
+
+
+def test_gcm_tamper_detection():
+    key = CipherUtils.gen_key(128)
+    c = AESCipher("AES_GCM_NoPadding")
+    msg = b"authenticated model payload"
+    ct = bytearray(c.encrypt(msg, key))
+    assert c.decrypt(bytes(ct), key) == msg
+    ct[20] ^= 0xFF  # flip a body byte
+    with pytest.raises(Exception):
+        c.decrypt(bytes(ct), key)
+
+
+def test_factory_config_and_key_file(tmp_path):
+    cfg = tmp_path / "cipher.conf"
+    cfg.write_text("# model cipher config\n"
+                   "cipher_name AES_GCM_NoPadding\n"
+                   "iv_size 128\n"
+                   "tag_size 128\n")
+    c = CipherFactory.create_cipher(str(cfg))
+    assert isinstance(c, AESCipher) and c._name == "AES_GCM_NoPadding"
+    key = CipherUtils.gen_key_to_file(256, str(tmp_path / "k.bin"))
+    assert CipherUtils.read_key_from_file(str(tmp_path / "k.bin")) == key
+    # default factory: CTR (reference cipher.cc default)
+    assert CipherFactory.create_cipher()._name == "AES_CTR_NoPadding"
+
+
+def test_encrypted_model_artifact_roundtrip(tmp_path):
+    """Encrypt a jit.save artifact, decrypt, reload, same outputs —
+    the end-to-end 'ship encrypted inference model' flow."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import InputSpec, load, save
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    want = net(x).numpy()
+    save(net, str(tmp_path / "m"),
+         input_spec=[InputSpec(shape=[1, 4], dtype="float32")])
+
+    key = CipherUtils.gen_key(256)
+    c = AESCipher("AES_GCM_NoPadding")
+    raw = open(tmp_path / "m.pdiparams", "rb").read()
+    c.encrypt_to_file(raw, key, str(tmp_path / "m.pdiparams.enc"))
+    (tmp_path / "m.pdiparams").unlink()
+
+    # consumer side: decrypt params, restore, load
+    dec = c.decrypt_from_file(key, str(tmp_path / "m.pdiparams.enc"))
+    open(tmp_path / "m.pdiparams", "wb").write(dec)
+    m2 = load(str(tmp_path / "m"))
+    out = m2(x)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    np.testing.assert_allclose(np.squeeze(out.numpy()),
+                               np.squeeze(want), rtol=1e-6)

@@ -975,3 +975,83 @@ def test_traced_return_trains():
         opt.clear_grad()
         losses.append(float(loss.item()))
     assert losses[-1] < losses[0]
+
+
+# -- r5 stragglers: assert / cast transformers, grad-inside-to_static ----
+
+def _write_straggler_mod(tmp_path):
+    src = tmp_path / "mod_straggler.py"
+    src.write_text(
+        "import paddle_tpu as paddle\n"
+        "def asserts(x):\n"
+        "    assert paddle.mean(x) > 0, 'mean must be positive'\n"
+        "    return x * 2\n"
+        "def casts(x):\n"
+        "    n = int(paddle.sum(x))\n"
+        "    f = float(n) / 2.0\n"
+        "    return x * f\n"
+        "def bool_cast(x):\n"
+        "    b = bool(paddle.max(x) > 0)\n"
+        "    return paddle.cast(b, 'float32') + x\n"
+        "def grad_inside(x):\n"
+        "    y = paddle.sum(x * x)\n"
+        "    g = paddle.grad(y, [x], create_graph=False)[0]\n"
+        "    return g * 2\n")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("mod_straggler", src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_assert_transformer(tmp_path):
+    mod = _write_straggler_mod(tmp_path)
+    f = paddle.jit.to_static(mod.asserts)
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(3))
+    # traced assert fails loudly at RUN time (reference Assert op)
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    with pytest.raises(Exception, match="mean must be positive"):
+        f(neg)
+
+
+def test_cast_transformer(tmp_path):
+    mod = _write_straggler_mod(tmp_path)
+    f = paddle.jit.to_static(mod.casts)
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    # sum=4 -> int 4 -> float 2.0
+    np.testing.assert_allclose(f(x).numpy(), 2 * np.ones(4))
+    g = paddle.jit.to_static(mod.bool_cast)
+    np.testing.assert_allclose(g(x).numpy(), 2 * np.ones(4))
+
+
+def test_grad_inside_to_static(tmp_path):
+    mod = _write_straggler_mod(tmp_path)
+    f = paddle.jit.to_static(mod.grad_inside)
+    x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    # d/dx sum(x^2) = 2x; result = 4x (reference grad_transformer)
+    np.testing.assert_allclose(f(x).numpy(), 4 * np.asarray([1, 2, 3]),
+                               rtol=1e-6)
+
+
+def test_grad_inside_callee(tmp_path):
+    """grad() in a CALLEE of the to_static function (review r5): the
+    tape turns on at the converted call site, not just the root."""
+    src = tmp_path / "mod_gcallee.py"
+    src.write_text(
+        "import paddle_tpu as paddle\n"
+        "def helper(x):\n"
+        "    y = paddle.sum(x * x)\n"
+        "    return paddle.grad(y, [x], create_graph=False)[0]\n"
+        "def outer(x):\n"
+        "    return helper(x) * 2\n")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("mod_gcallee", src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    f = paddle.jit.to_static(mod.outer)
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    np.testing.assert_allclose(f(x).numpy(), 4 * np.ones(3), rtol=1e-6)
