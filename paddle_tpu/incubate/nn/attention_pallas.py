@@ -83,11 +83,16 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * sm_scale       # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-        v = v_ref[0].astype(jnp.float32)                  # [BK, D]
+        # keep matmul OPERANDS in the input dtype (bf16): the MXU is
+        # bf16-native with f32 accumulation — casting q/k/v up to f32
+        # before the dots ran the matmuls on the slow f32 path (r5).
+        # Softmax statistics stay f32 (preferred_element_type).
+        q = q_ref[0]                                      # [BQ, D]
+        k = k_ref[0]                                      # [BK, D]
+        v = v_ref[0]                                      # [BK, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         m_prev = m_ref[:, :1]                             # [BQ, 1]
@@ -98,7 +103,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -114,6 +119,12 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
                     interpret=False):
+    # the kernels run matmuls on the operands' own dtype (bf16-native
+    # MXU, f32 accumulation) — promote mixed inputs to one dtype here
+    # so a bf16 q with an f32 KV cache doesn't die inside the kernel
+    # (and silently fall back to dense through callers' try/except)
+    ct = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype), v.dtype)
+    q, k, v = q.astype(ct), k.astype(ct), v.astype(ct)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = _pick_block(sq, block_q)
@@ -173,10 +184,11 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul operands, f32 accumulation/statistics (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]                           # [BQ, 1]
         delta = delta_ref[0][:, :1]                       # [BQ, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -187,7 +199,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)                              # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -212,10 +224,11 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul operands, f32 accumulation/statistics (see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]                           # [BQ, 1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -224,13 +237,14 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse)                              # [BQ, BK]
+        pb = p.astype(do.dtype)
         # dv_j += p^T @ do
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale                  # [BQ, BK]
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         # dk_j += ds^T @ q
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -244,6 +258,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
                     block_q, block_k, interpret=False):
+    ct = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype),
+                           jnp.promote_types(v.dtype, do.dtype))
+    q, k, v, do = (q.astype(ct), k.astype(ct), v.astype(ct),
+                   do.astype(ct))
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = _pick_block(sq, block_q)
@@ -342,8 +360,11 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
-                           block_q, block_k, interpret)
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
+                                 block_q, block_k, interpret)
+    # custom_vjp contract: cotangents match the PRIMAL dtypes even
+    # when mixed inputs were promoted inside the impl
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 flash_attention.defvjp(_fwd, _bwd)

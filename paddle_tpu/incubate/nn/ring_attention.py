@@ -52,7 +52,10 @@ def _chunk_attn_partial(q, k_blk, v_blk, q_off, k_off, causal, sm_scale):
     m = jnp.max(s, axis=-1, keepdims=True)                # [b,h,sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+    # bf16 matmul operands, f32 accumulation — same MXU policy as the
+    # flash kernels (r5); statistics stay f32
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+                    preferred_element_type=jnp.float32)
     return m, l, pv
 
 
@@ -67,7 +70,7 @@ def ring_attention_shard(q, k, v, axis_name="sp", causal=True,
     b, h, s_local, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    qf = q.astype(jnp.float32)
+    qf = q  # bf16-native MXU: operands stay in input dtype (r5)
     q_off = my * s_local
     perm = [(j, (j + 1) % nsteps) for j in range(nsteps)]
 
@@ -76,8 +79,7 @@ def ring_attention_shard(q, k, v, axis_name="sp", causal=True,
         # this block originated at rank (my - i) mod sp
         k_off = ((my - i) % nsteps) * s_local
         m_cur, l_cur, pv = _chunk_attn_partial(
-            qf, k_blk.astype(jnp.float32), v_blk, q_off, k_off,
-            causal, sm_scale)
+            qf, k_blk, v_blk, q_off, k_off, causal, sm_scale)
         m_new = jnp.maximum(m, m_cur)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(m_cur - m_new)

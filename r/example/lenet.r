@@ -1,0 +1,38 @@
+#!/usr/bin/env Rscript
+# LeNet inference via paddle_tpu from R (reference: r/example/mobilenet.r)
+
+library(reticulate)
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+set_config <- function(model_dir) {
+    config <- inference$Config(
+        file.path(model_dir, "m.pdmodel"),
+        file.path(model_dir, "m.pdiparams"))
+    config$enable_memory_optim()
+    return(config)
+}
+
+run_lenet <- function(model_dir) {
+    config <- set_config(model_dir)
+    predictor <- inference$create_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_tensor <- predictor$get_input_handle(input_names[1])
+    x <- np$random$randn(1L, 1L, 28L, 28L)$astype("float32")
+    input_tensor$copy_from_cpu(x)
+
+    predictor$run()
+
+    output_names <- predictor$get_output_names()
+    output_tensor <- predictor$get_output_handle(output_names[1])
+    y <- output_tensor$copy_to_cpu()
+    cat("output shape:", paste(dim(y), collapse = "x"), "\n")
+    return(y)
+}
+
+if (!interactive()) {
+    args <- commandArgs(trailingOnly = TRUE)
+    run_lenet(if (length(args) >= 1) args[1] else "model")
+}

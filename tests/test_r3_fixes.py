@@ -3,7 +3,7 @@
 - unknown ReduceOp raises in the trace path
 - multi-axis (world) group broadcast/all_gather cover ALL bound axes
 - static cond/while pass-through branch outputs resolve (ADVICE r2 #2)
-- honesty: strategy.dgc/localsgd raise; sharding offload=True raises
+- honesty: strategy.dgc raises (localsgd supported since r5); sharding offload=True raises
 - strategy.amp O1 wires auto_cast into the compiled step
 """
 import numpy as np
@@ -224,15 +224,20 @@ def test_static_while_passthrough_body_output():
 
 def test_strategy_dgc_localsgd_raise():
     # r4: the refusal moved from the meta-optimizer chain to the
-    # assignment site — the closed schema rejects the knob immediately
+    # assignment site. r5: localsgd/adaptive_localsgd are EXACT
+    # algorithms and now supported (fleet/meta_optimizers); only lossy
+    # gradient compression (dgc) keeps the design refusal.
     from paddle_tpu.distributed import fleet
 
-    for knob in ("dgc", "localsgd", "adaptive_localsgd"):
+    strategy = fleet.DistributedStrategy()
+    with pytest.raises(NotImplementedError, match="dgc"):
+        strategy.dgc = True
+    strategy.dgc = False  # falsy reset stays legal
+    assert strategy.dgc is False
+    for knob in ("localsgd", "adaptive_localsgd"):
         strategy = fleet.DistributedStrategy()
-        with pytest.raises(NotImplementedError, match=knob):
-            setattr(strategy, knob, True)
-        setattr(strategy, knob, False)  # falsy reset stays legal
-        assert getattr(strategy, knob) is False
+        setattr(strategy, knob, True)  # supported since r5
+        assert getattr(strategy, knob) is True
 
 
 def test_strategy_closed_schema():
@@ -357,4 +362,5 @@ def test_strategy_unsupported_configs_read_as_dict():
 
     s = fleet.DistributedStrategy()
     assert s.dgc_configs == {}
-    assert s.localsgd_configs.get("k_steps") is None
+    # localsgd_configs is a real config field since r5
+    assert s.localsgd_configs.get("k_steps") == 1
