@@ -195,23 +195,31 @@ class CachedEmbedding:
     def prefetch(self, ids):
         """Warm the cache with the NEXT batch's rows on a background
         thread (heter_comm pull pipeline). Joined by the next
-        forward()."""
+        forward(); a warm-up failure re-raises at join (review r5 —
+        a swallowed error would leave the cache silently cold)."""
         ids_np = np.unique(
             np.asarray(getattr(ids, "_value", ids)).astype(np.int64))
+        err = [None]
 
         def _work():
-            self._ensure_resident(ids_np, from_prefetch=True)
+            try:
+                self._ensure_resident(ids_np, from_prefetch=True)
+            except Exception as e:  # re-raised by join_prefetch
+                err[0] = e
 
         self.join_prefetch()
         t = threading.Thread(target=_work, daemon=True)
         t.start()
-        self._prefetch_thread = t
+        self._prefetch_thread = (t, err)
 
     def join_prefetch(self):
-        t = self._prefetch_thread
-        if t is not None:
+        ent = self._prefetch_thread
+        if ent is not None:
+            t, err = ent
             t.join()
             self._prefetch_thread = None
+            if err[0] is not None:
+                raise err[0]
 
     def forward(self, ids):
         import jax.numpy as jnp
@@ -228,8 +236,13 @@ class CachedEmbedding:
                 f"embedding id out of range [0, {self.num_embeddings}):"
                 f" min={flat.min()}, max={flat.max()}")
         uniq, inverse = np.unique(flat, return_inverse=True)
-        slots = self._ensure_resident(uniq)
-        rows_t = to_tensor(self.cache.rows(slots))
+        # residency + gather are atomic under the cache lock: a
+        # concurrent thread's admit-with-eviction must not reassign a
+        # hit slot between split() and rows() (review r5 — hogwild
+        # threads share one cache via HeterTrainer)
+        with self.cache._lock:
+            slots = self._ensure_resident(uniq)
+            rows_t = to_tensor(self.cache.rows(slots))
         rows_t.stop_gradient = False
 
         def _k(rows_v, inv):

@@ -221,3 +221,59 @@ class PipelineTrainer:
             raise RuntimeError(
                 f"pipeline section failed: {errs[0]!r}") from errs[0]
         return [outs[i] for i in range(n)]
+
+
+class HeterTrainer(DownpourTrainer):
+    """PSGPUTrainer / HeterXpuTrainer analog (reference trainer.h:295,
+    328 + fleet/heter_ps/ps_gpu_wrapper.cc): the device-cache pass
+    workflow. Each PASS: (1) build_pass bulk-loads the pass's sparse
+    keys into the HBM row cache (BuildGPUTask's prebuilt device
+    hashmap); (2) hogwild threads train through CachedEmbedding
+    handles — hot rows never touch the PS; (3) end_pass joins
+    prefetches, flushes the async pusher, and reports cache residency
+    stats (PSGPUWrapper::EndPass).
+    """
+
+    def __init__(self, desc: TrainerDesc, client: PSClient,
+                 embeddings=None):
+        super().__init__(desc, client)
+        # table name -> CachedEmbedding (the device cache tier)
+        self.embeddings = dict(embeddings or {})
+
+    def add_embedding(self, name, emb):
+        self.embeddings[name] = emb
+
+    def embedding(self, name):
+        return self.embeddings[name]
+
+    def build_pass(self, pass_keys):
+        """pass_keys: {table: id array} — warm every table's cache
+        with the pass's keys (one bulk pull per table, reference
+        BuildGPUTask) before the worker threads start."""
+        for table, ids in pass_keys.items():
+            emb = self.embeddings[table]
+            emb.prefetch(ids)
+        for table in pass_keys:
+            self.embeddings[table].join_prefetch()
+        return self
+
+    def train_from_dataset(self, dataset, train_fn, timeout=None,
+                           pass_keys=None):
+        if pass_keys is not None:
+            self.build_pass(pass_keys)
+        return super().train_from_dataset(dataset, train_fn, timeout)
+
+    def end_pass(self):
+        """Flush in-flight state (the trainer's async pusher AND each
+        embedding's own communicator — review r5) and report per-table
+        cache stats."""
+        for emb in self.embeddings.values():
+            emb.join_prefetch()
+        if self.communicator is not None:
+            self.communicator.flush()
+        for emb in self.embeddings.values():
+            comm = getattr(emb, "_comm", None)
+            if comm is not None and hasattr(comm, "flush"):
+                comm.flush()
+        return {name: emb.stats()
+                for name, emb in self.embeddings.items()}

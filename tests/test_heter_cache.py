@@ -149,3 +149,43 @@ def test_capacity_smaller_than_batch_raises(cluster):
     emb = CachedEmbedding(client, "tiny_emb", 1024, 4, capacity=8)
     with pytest.raises(ValueError, match="cache"):
         emb.forward(paddle.to_tensor(np.arange(64, dtype=np.int64)))
+
+
+def test_heter_trainer_pass_workflow(cluster):
+    """PSGPUTrainer-analog pass: build_pass warms the cache, hogwild
+    threads train through it, end_pass reports stats (reference
+    trainer.h:295 PSGPUTrainer + ps_gpu_wrapper BuildGPUTask/EndPass)."""
+    from paddle_tpu.distributed.ps.trainer import HeterTrainer, TrainerDesc
+
+    servers, client = cluster
+    n_rows, dim = 1024, 8
+    emb = CachedEmbedding(client, "ht_emb", n_rows, dim, capacity=512,
+                          lr=0.05)
+    desc = TrainerDesc(thread_num=2, lr=0.05)
+    trainer = HeterTrainer(desc, client, embeddings={"ht_emb": emb})
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, 256, 32) for _ in range(12)]
+    pass_ids = np.unique(np.concatenate(batches))
+    trainer.build_pass({"ht_emb": pass_ids})
+    pulls_after_build = client.pull_rpcs
+    misses_after_build = emb.stats()["misses"]
+
+    losses = []
+
+    def train_fn(batch, wid):
+        e = trainer.embedding("ht_emb")
+        out = e.forward(paddle.to_tensor(batch.astype(np.int64)))
+        loss = paddle.mean(out ** 2)
+        losses.append(float(loss.item()))
+        loss.backward()
+
+    trainer.run(batches, train_fn).finalize(timeout=120)
+    stats = trainer.end_pass()["ht_emb"]
+    # the pass was prebuilt: training pulled NOTHING from the PS
+    # (the build pass itself recorded its compulsory misses)
+    assert client.pull_rpcs == pulls_after_build
+    assert stats["hits"] > 0
+    assert stats["misses"] == misses_after_build
+    # learning happened (rows shrink under d/dx mean(x^2))
+    assert min(losses[-3:]) < max(losses[:3])
