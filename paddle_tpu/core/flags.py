@@ -29,6 +29,13 @@ _FLAGS = {
     # numerics
     "check_nan_inf": _env("check_nan_inf", False, bool),
     "default_dtype": _env("default_dtype", "float32", str),
+    # BatchNorm training statistics: the default one-pass
+    # E[x^2]-E[x]^2 form reads the activation once (fast; exact for
+    # the usual post-conv O(1)-magnitude inputs) but cancels
+    # catastrophically when |mean| >> std. Set FLAGS_stable_bn_stats=1
+    # for the two-pass shifted-variance form on un-normalized-input
+    # workloads (~20% slower ResNet-50 step; r4 advisor low #3).
+    "stable_bn_stats": _env("stable_bn_stats", False, bool),
     # eager dispatch
     "eager_op_jit": _env("eager_op_jit", True, bool),  # per-op jit cache
     "benchmark": _env("benchmark", False, bool),  # block_until_ready each op

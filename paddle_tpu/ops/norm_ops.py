@@ -55,7 +55,7 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
 
 
 def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
-                  channel_axis):
+                  channel_axis, stable_stats=False):
     """TPU-tuned BN: statistics in f32 via ONE pass (E[x], E[x²] fused
     into a single read of x — jnp.var's two-pass form reads the
     activation twice and measurably slows ResNet-50 on v5e), then the
@@ -63,21 +63,33 @@ def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
     the bf16 activation never round-trips through an f32 copy. Matches
     reference batch_norm_op numerics at bf16 resolution (stats f32)."""
     reduce_axes = tuple(a for a in range(x.ndim) if a != channel_axis)
+    shape = [1] * x.ndim                  # per-channel broadcast shape
+    shape[channel_axis] = x.shape[channel_axis]
     if training:
         xf = x.astype(jnp.float32)
-        # plain E[x], E[x^2] stats. Round-3 shipped a "shifted
-        # one-pass" variant (subtract a per-channel sample before the
-        # moments) justified by a +9% probe — re-measured in r4 with
-        # TRUTHFUL syncs (see benchmarks/gemm_probe.py on the broken
-        # block_until_ready), the shift MATERIALIZES a full f32 copy
-        # of the activation (x - shift) whose forward+VJP traffic cost
-        # ~30% extra HBM bytes and ~20% ResNet-50 throughput. The
-        # numerically-risky |mean| >> std case (naive cancellation)
-        # is guarded by the f32 accumulate + clamp; BN inputs in
-        # practice are post-conv activations with O(1) magnitudes.
+        # plain E[x], E[x^2] stats by default. Round-3 shipped a
+        # "shifted one-pass" variant (subtract a per-channel sample
+        # before the moments) justified by a +9% probe — re-measured
+        # in r4 with TRUTHFUL syncs (see benchmarks/gemm_probe.py on
+        # the broken block_until_ready), the shift MATERIALIZES a full
+        # f32 copy of the activation (x - shift) whose forward+VJP
+        # traffic cost ~30% extra HBM bytes and ~20% ResNet-50
+        # throughput. The numerically-risky |mean| >> std case (naive
+        # cancellation) is a USER-FACING documented restriction (r4
+        # advisor): the opt-in FLAGS_stable_bn_stats=1 switches to the
+        # cancellation-free two-pass form for un-normalized inputs.
+        # The flag is resolved by the DISPATCH wrapper (batch_norm)
+        # and arrives as the static kwarg `stable_stats` so it joins
+        # the jit cache key — a trace-time read would bake the first
+        # value into cached executables (review r5).
         batch_mean = jnp.mean(xf, axis=reduce_axes)
-        batch_var = (jnp.mean(jnp.square(xf), axis=reduce_axes)
-                     - jnp.square(batch_mean))
+        if stable_stats:
+            centered = xf - batch_mean.reshape(shape)
+            batch_var = jnp.mean(jnp.square(centered),
+                                 axis=reduce_axes)
+        else:
+            batch_var = (jnp.mean(jnp.square(xf), axis=reduce_axes)
+                         - jnp.square(batch_mean))
         batch_var = jnp.maximum(batch_var, 0.0)
         use_mean, use_var = batch_mean, batch_var
         n = x.size // x.shape[channel_axis]
@@ -87,8 +99,6 @@ def _k_batch_norm(x, mean, var, weight, bias, eps, momentum, training,
     else:
         use_mean, use_var = mean, var
         new_mean, new_var = mean, var
-    shape = [1] * x.ndim
-    shape[channel_axis] = x.shape[channel_axis]
     inv = jax.lax.rsqrt(use_var + eps)
     scale = inv if weight is None else inv * weight.astype(jnp.float32)
     shift = -use_mean * scale
@@ -108,10 +118,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         training = False
     ca = x.ndim - 1 if data_format in ("NHWC", "NLC", "NDHWC") else (
         1 if x.ndim > 1 else 0)
+    from ..core import flags as _flags
+
     out, new_mean, new_var = apply_op(
         "batch_norm", _k_batch_norm, x, running_mean, running_var, weight,
         bias, eps=float(epsilon), momentum=float(momentum),
-        training=bool(training), channel_axis=ca)
+        training=bool(training), channel_axis=ca,
+        stable_stats=bool(_flags.get_flag("stable_bn_stats")))
     return out, new_mean, new_var
 
 
