@@ -1,0 +1,93 @@
+"""Op-bench regression-gate logic tests (offline — no chip needed).
+
+The harness itself runs on TPU (baseline recorded there); these tests
+pin the GATE semantics: volatile baselines skip loudly, slowdowns /
+crashes / missing ops fail, clean runs pass."""
+import json
+import os
+import sys
+
+import pytest
+
+BENCH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks")
+sys.path.insert(0, BENCH_DIR)
+
+
+@pytest.fixture()
+def gate(tmp_path, monkeypatch):
+    import jax
+
+    import op_bench
+
+    base = {
+        "platform": jax.devices()[0].platform,
+        "ops": {
+            "stable_op": {"us": 100.0, "gbps": 10.0},
+            "volatile_op": {"us": 50.0, "volatile": True,
+                            "volatile_note": "1/2/2000us samples"},
+            "unresolved_base": {"unresolved": True},
+        },
+    }
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(base))
+    monkeypatch.setattr(op_bench, "BASELINE_PATH", str(path))
+
+    def run(results, argv=("--check",)):
+        monkeypatch.setattr(op_bench, "run_all",
+                            lambda n=16: dict(results))
+        monkeypatch.setattr(sys, "argv", ["op_bench.py", *argv])
+        return op_bench.main()
+
+    return run, path
+
+
+def test_clean_run_passes(gate, capsys):
+    run, _ = gate
+    rc = run({"stable_op": {"us": 105.0},
+              "volatile_op": {"us": 9000.0},       # skipped: volatile
+              "unresolved_base": {"us": 5.0}})     # skipped: no base
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "SKIP volatile_op" in err
+    assert "SKIP unresolved_base" in err
+
+
+def test_slowdown_crash_and_missing_fail(gate, capsys):
+    run, _ = gate
+    rc = run({"stable_op": {"us": 200.0}})          # slow + others gone
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION stable_op" in err
+
+    rc = run({"stable_op": {"error": "boom"},
+              "volatile_op": {"us": 50.0},
+              "unresolved_base": {"us": 5.0}})
+    assert rc == 1
+    rc = run({"volatile_op": {"us": 50.0},
+              "unresolved_base": {"us": 5.0}})      # stable_op missing
+    assert rc == 1
+
+
+def test_save_merge_keeps_resolved_and_marks_volatile(gate, capsys):
+    run, path = gate
+    rc = run({"stable_op": {"us": 0.0},             # 0-rounded: KEEP
+              "volatile_op": {"us": 55.0},
+              "unresolved_base": {"unresolved": True}},
+             argv=("--save",))
+    assert rc == 0
+    saved = json.loads(path.read_text())
+    # resolved entry survived the unresolved re-save
+    assert saved["ops"]["stable_op"]["us"] == 100.0
+    # volatility is sticky
+    assert saved["ops"]["volatile_op"]["volatile"] is True
+
+    # a >tol move on identical code marks the op volatile
+    rc = run({"stable_op": {"us": 300.0},
+              "volatile_op": {"us": 55.0},
+              "unresolved_base": {"unresolved": True}},
+             argv=("--save",))
+    saved = json.loads(path.read_text())
+    assert saved["ops"]["stable_op"].get("volatile") is True
+    err = capsys.readouterr().err
+    assert "DELTA stable_op" in err
