@@ -500,6 +500,16 @@ def main():
             results[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
 
+    # full telemetry trail of the run (jit compile counters, comm
+    # bytes, io + step stats) — the StatRegistry snapshot the monitor
+    # exporter would flush, embedded so every bench record carries it
+    try:
+        from paddle_tpu import monitor as _monitor
+
+        results["telemetry"] = _monitor.telemetry_snapshot()
+    except Exception as e:
+        results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+
     flag = results.get("gpt2_345m", {})
     out = {
         "metric": ("gpt2_345m_train_tokens_per_sec_per_chip" if on_tpu

@@ -51,6 +51,7 @@ from . import autograd
 from . import incubate
 from . import inference
 from . import profiler
+from . import monitor
 from . import text
 from . import hub
 from . import onnx

@@ -81,6 +81,8 @@ def register_flag(name, default):
         _FLAGS.setdefault(name, default)
 
 
-def VLOG(level: int, msg: str):
-    if _FLAGS["v"] >= level:
-        print(f"[VLOG{level}] {msg}")
+# One VLOG implementation for the whole stack: re-export the canonical
+# stderr/GLOG_v-honoring version (monitor.vlog_level also consults
+# FLAGS_v, so both configuration surfaces keep working). The local
+# stdout copy this replaced ignored GLOG_v and timestamps.
+from .monitor import VLOG  # noqa: E402,F401

@@ -12,8 +12,8 @@ import threading
 import time
 
 __all__ = ["StatValue", "StatRegistry", "stat_add", "stat_get",
-           "stat_reset", "registry", "VLOG", "vlog_level",
-           "device_memory_stats"]
+           "stat_set", "stat_reset", "registry", "VLOG", "vlog_level",
+           "device_memory_stats", "device_memory_in_use"]
 
 
 class StatValue:
@@ -33,6 +33,19 @@ class StatValue:
 
     def decrease(self, n=1):
         return self.increase(-n)
+
+    def set(self, n):
+        """Gauge-style overwrite (step time, memory high-water)."""
+        with self._lock:
+            self._v = n
+            return self._v
+
+    def maximum(self, n):
+        """Keep the high-water mark (peak device memory)."""
+        with self._lock:
+            if n > self._v:
+                self._v = n
+            return self._v
 
     def reset(self):
         with self._lock:
@@ -54,9 +67,24 @@ class StatRegistry:
                 self._stats[name] = StatValue(name)
             return self._stats[name]
 
-    def all(self):
+    def snapshot(self):
+        """Consistent point-in-time copy of every stat, taken under the
+        registry lock (the exporter's read path)."""
         with self._lock:
-            return {k: v.get() for k, v in self._stats.items()}
+            stats = list(self._stats.items())
+        return {k: v.get() for k, v in stats}
+
+    def reset_all(self):
+        """Zero every registered stat, holding the registry lock while
+        collecting the stat list (stat_reset(None) previously iterated
+        `_stats` unlocked and could miss/clash with concurrent get())."""
+        with self._lock:
+            stats = list(self._stats.values())
+        for v in stats:
+            v.reset()
+
+    def all(self):
+        return self.snapshot()
 
 
 registry = StatRegistry()
@@ -67,14 +95,18 @@ def stat_add(name, n=1):
     return registry.get(name).increase(n)
 
 
+def stat_set(name, n):
+    """Gauge write: overwrite the stat with `n`."""
+    return registry.get(name).set(n)
+
+
 def stat_get(name):
     return registry.get(name).get()
 
 
 def stat_reset(name=None):
     if name is None:
-        for v in list(registry._stats.values()):
-            v.reset()
+        registry.reset_all()
     else:
         registry.get(name).reset()
 
@@ -91,17 +123,37 @@ def device_memory_stats(device=None):
         return {}
 
 
+def device_memory_in_use(device=None):
+    """(bytes_in_use, peak_bytes_in_use) from PJRT, or (0, 0) when the
+    backend exposes no memory stats (the CPU client often doesn't)."""
+    stats = device_memory_stats(device)
+    used = int(stats.get("bytes_in_use", 0) or 0)
+    peak = int(stats.get("peak_bytes_in_use", used) or used)
+    return used, peak
+
+
 # -- VLOG -------------------------------------------------------------------
+# The ONE VLOG implementation (stderr, glog-style prefix). core/flags.py
+# re-exports this same function — the two previously diverged (flags'
+# copy printed to stdout and ignored GLOG_v).
 
 def vlog_level():
+    """Effective verbosity: max(GLOG_v env, FLAGS_v flag)."""
     try:
-        return int(os.environ.get("GLOG_v", "0"))
+        env = int(os.environ.get("GLOG_v", "0"))
     except ValueError:
-        return 0
+        env = 0
+    try:
+        from . import flags as _flags
+
+        return max(env, int(_flags.get_flag("v")))
+    except Exception:
+        return env
 
 
 def VLOG(level, *msg):
-    """glog VLOG(level) << ... analog; enabled by GLOG_v env."""
+    """glog VLOG(level) << ... analog; enabled by GLOG_v env or
+    FLAGS_v."""
     if level <= vlog_level():
         ts = time.strftime("%H:%M:%S")
         print(f"V{level} {ts}]", *msg, file=sys.stderr)

@@ -21,11 +21,16 @@ TPU-native design, two execution regimes:
 """
 from __future__ import annotations
 
+import functools
+import time as _time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import profiler as _profiler
+from ..core import monitor as _monitor
 from ..core.engine import apply_op, in_trace_mode
 from ..core.tensor import Tensor
 from . import mesh as mesh_mod
@@ -45,6 +50,67 @@ class ReduceOp:
     MIN = 2
     PROD = 3
     AVG = 4
+
+
+def _payload_bytes(x):
+    """Byte size of a collective's payload from STATIC shape/dtype info
+    (works on tracers — inside shard_map the span measures trace time
+    but the byte count is still the per-rank payload)."""
+    if isinstance(x, Tensor):
+        x = x._value
+    if isinstance(x, (list, tuple)):
+        return sum(_payload_bytes(e) for e in x)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _instrumented(op):
+    """Per-collective telemetry (reference: RecordEvent at every c_*
+    op + STAT_ADD comm counters): a `comm/<op>` host span when a
+    profiler is capturing, and `comm/<op>/{calls,bytes,host_us}`
+    registry counters always. `host_us` is host-side dispatch/transport
+    wall time — inside a compiled trace that is trace-time, the device
+    time lives in the XPlane capture."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            # payload = the `tensor` kwarg if given, else the first
+            # tensor-bearing positional arg (all_gather's first arg is
+            # the EMPTY output list — its payload is the second).
+            # Measured BEFORE the call: all_gather fills that output
+            # list, and measuring after would record world_size x the
+            # per-rank payload
+            candidates = []
+            if "tensor" in kwargs:
+                candidates.append(kwargs["tensor"])
+            candidates.extend(args[:2])
+            if "in_tensor_list" in kwargs:
+                candidates.append(kwargs["in_tensor_list"])
+            nbytes = 0
+            for a in candidates:
+                nbytes = _payload_bytes(a)
+                if nbytes:
+                    break
+            t0 = _time.perf_counter()
+            with _profiler.RecordEvent(f"comm/{op}", "Communication"):
+                out = fn(*args, **kwargs)
+            _monitor.stat_add(f"comm/{op}/calls", 1)
+            _monitor.stat_add(
+                f"comm/{op}/host_us",
+                int((_time.perf_counter() - t0) * 1e6))
+            if nbytes:
+                _monitor.stat_add(f"comm/{op}/bytes", nbytes)
+            return out
+
+        return wrapped
+
+    return deco
 
 
 def _axis_names(group):
@@ -163,6 +229,7 @@ def _reduce_in_trace(v, op, axes):
         f"paddle.distributed.all_reduce: unsupported ReduceOp {op!r}")
 
 
+@_instrumented("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_allreduce_* analog (collective/c_allreduce_op.h:359)."""
     axes = _axis_names(group)
@@ -241,6 +308,7 @@ def get_group_rank(group, global_rank):
         else -1
 
 
+@_instrumented("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """c_broadcast analog — single-controller: value is already
     replicated; in shard_map trace, select src's value via a masked
@@ -297,6 +365,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
 
 
+@_instrumented("all_gather")
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """collective.py:618. Eager single-controller: every 'rank' holds
     the global value, so gather = replicate."""
@@ -335,12 +404,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_instrumented("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         tensor.set_value(tensor_list[src if src < len(tensor_list) else 0])
     return tensor
 
 
+@_instrumented("alltoall")
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """MoE routing primitive (global_scatter/global_gather cousin)."""
     axes = _axis_names(group)
@@ -409,6 +480,7 @@ def _entry_is_current(probe, ax):
         return False
 
 
+@_instrumented("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     """send_v2 analog (operators/collective/send_v2_op.cc).
 
@@ -454,6 +526,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
         "step where the pair lowers to collective-permute")
 
 
+@_instrumented("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     """recv_v2 analog — completes the outstanding send on this axis
     (see send). Returns the received tensor and rebinds the user's
@@ -499,6 +572,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
         "has no peer — see send()")
 
 
+@_instrumented("barrier")
 def barrier(group=None):
     """barrier op analog. Multi-process eager: a real cross-process
     rendezvous through the TCP store (reference barrier op over gloo)

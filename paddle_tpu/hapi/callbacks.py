@@ -7,7 +7,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "config_callbacks"]
+           "EarlyStopping", "VisualDL", "Telemetry", "config_callbacks"]
 
 
 class Callback:
@@ -152,6 +152,47 @@ class EarlyStopping(Callback):
                 self.model.stop_training = True
 
 
+class Telemetry(Callback):
+    """Per-step training telemetry (the train-loop leg of the unified
+    paddle_tpu.monitor subsystem): drives a monitor.StepTimer so every
+    Model.fit step records step time, throughput, loss and lr into the
+    `step/...` StatRegistry stats (plus PJRT device-memory high water),
+    and — when a profiler.Profiler is capturing — mirrors them as
+    chrome-trace counter (ph "C") samples on the merged timeline.
+
+    config_callbacks installs one automatically, so fit() runs always
+    leave `step/...` metrics behind; pass your own instance to share
+    its StepTimer with other consumers."""
+
+    def __init__(self, step_timer=None):
+        super().__init__()
+        if step_timer is None:
+            from .. import monitor as _mon
+
+            step_timer = _mon.StepTimer()
+        self.step_timer = step_timer
+
+    def _lr(self):
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return None
+        try:
+            return float(opt.get_lr())
+        except Exception:
+            return None
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.step_timer.begin_step()
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        self.step_timer.end_step(batch_size=logs.get("batch_size"),
+                                 loss=loss, lr=self._lr())
+
+
 class VisualDL(Callback):
     def __init__(self, log_dir="./log"):
         super().__init__()
@@ -170,6 +211,13 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks.append(LRScheduler())
+    if not any(isinstance(c, Telemetry) for c in cbks):
+        # FIRST in dispatch order: on_train_batch_end must read the lr
+        # the step actually ran at, BEFORE any LRScheduler callback
+        # (auto-installed or user-passed, both later in the list)
+        # advances the schedule — appending would record the NEXT
+        # step's lr at every decay boundary
+        cbks.insert(0, Telemetry())
     cl = CallbackList(cbks)
     for c in cbks:
         c.set_model(model)

@@ -19,10 +19,24 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import profiler as _profiler
 from ..core.tensor import Tensor, to_tensor
 from ..core.engine import no_grad
 from ..io import DataLoader, Dataset
 from . import callbacks as cb_mod
+
+
+def _batch_size_of(inputs):
+    """Leading-dim batch size of the first tensor-like input (None when
+    it can't be determined — e.g. scalar inputs)."""
+    for x in inputs:
+        shape = getattr(x, "shape", None)
+        if shape:
+            try:
+                return int(shape[0])
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 class Model:
@@ -143,10 +157,18 @@ class Model:
             for m in self._metrics:
                 m.reset()
             for step, batch in enumerate(loader):
-                cbks.on_batch_begin("train", step, None)
                 ins, lbls = self._split_batch(batch)
-                loss = self.train_batch(ins, lbls)
-                logs = {"loss": loss[0], "step": step}
+                bs = _batch_size_of(ins)
+                cbks.on_batch_begin("train", step, {"batch_size": bs})
+                # per-step host span (reference: RecordEvent around the
+                # trainer loop body) — batch size rides in args so the
+                # chrome trace shows it per step
+                with _profiler.RecordEvent(
+                        "hapi/train_step", "TrainStep",
+                        args={"batch_size": bs} if bs else None):
+                    loss = self.train_batch(ins, lbls)
+                logs = {"loss": loss[0], "step": step,
+                        "batch_size": bs}
                 cbks.on_batch_end("train", step, logs)
                 iters_done += 1
                 if num_iters is not None and iters_done >= num_iters:
@@ -173,7 +195,11 @@ class Model:
         losses = []
         for batch in loader:
             ins, lbls = self._split_batch(batch)
-            loss, _ = self.eval_batch(ins, lbls)
+            bs = _batch_size_of(ins)
+            with _profiler.RecordEvent(
+                    "hapi/eval_step", "EvalStep",
+                    args={"batch_size": bs} if bs else None):
+                loss, _ = self.eval_batch(ins, lbls)
             losses.append(loss[0])
         out = {"loss": [float(np.mean(losses))] if losses else [0.0]}
         for m in self._metrics:

@@ -13,9 +13,12 @@ import itertools
 import math
 import queue
 import threading
+import time as _time
 
 import numpy as np
 
+from .. import profiler as _profiler
+from ..core import monitor as _monitor
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = [
@@ -379,11 +382,21 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        samples = [self.dataset[i] for i in indices]
-        collate = self.collate_fn or _np_collate
-        if self.collate_fn is not None:
-            return collate(samples)
-        return _to_device(collate(samples))
+        # io telemetry: this runs on the CALLING thread — under the
+        # threaded prefetcher that is the producer thread, whose spans
+        # the process-wide recorder now captures (the thread-local
+        # recorder used to drop them)
+        with _profiler.RecordEvent("io/fetch_batch", "Dataloader"):
+            t0 = _time.perf_counter()
+            samples = [self.dataset[i] for i in indices]
+            collate = self.collate_fn or _np_collate
+            batch = collate(samples)
+            if self.collate_fn is None:
+                batch = _to_device(batch)
+        _monitor.stat_add("io/batches", 1)
+        _monitor.stat_add("io/fetch_us",
+                          int((_time.perf_counter() - t0) * 1e6))
+        return batch
 
     def _iter_batches(self):
         if self._iterable_mode:
@@ -464,7 +477,16 @@ class DataLoader:
         # them would add a gratuitous full-batch memcpy (review)
         detach = raw and _zero_copy_enabled()
         try:
-            for batch in loader.run_epoch(batches):
+            gen = loader.run_epoch(batches)
+            while True:
+                # span the blocking ring pop: time the trainer spends
+                # here is the input pipeline failing to keep up
+                with _profiler.RecordEvent("io/shm_pop", "Dataloader"):
+                    try:
+                        batch = next(gen)
+                    except StopIteration:
+                        break
+                _monitor.stat_add("io/batches", 1)
                 # zero-copy batches alias the shm ring slot, valid only
                 # until that worker's next batch is fetched. The
                 # default path's _to_device copies host->device before

@@ -525,8 +525,11 @@ class MultiprocessLoader:
         released on the NEXT pop of the same ring (pop_view), so a
         yielded batch stays valid until that worker's next batch is
         fetched — W batches of slack in the round-robin order."""
+        import time as _t
+
         tick = 2000
         waited = 0
+        t0 = _t.perf_counter()
         while True:
             if not self.procs:
                 raise RuntimeError("DataLoader was shut down while "
@@ -547,6 +550,14 @@ class MultiprocessLoader:
                 raise RuntimeError(
                     "a DataLoader worker process died unexpectedly "
                     "(killed or crashed) — see worker logs")
+        # telemetry: ring-wait time (trainer blocked on workers) +
+        # delivered payload bytes — io/ring_wait_us climbing while
+        # step/time holds steady means the pipeline is input-bound
+        from ..core import monitor as _monitor
+
+        _monitor.stat_add("io/ring_wait_us",
+                          int((_t.perf_counter() - t0) * 1e6))
+        _monitor.stat_add("io/ring_bytes", int(view.nbytes))
         batch = _decode_view(view)
         if batch is not None:
             return batch

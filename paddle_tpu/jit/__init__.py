@@ -16,13 +16,16 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time as _time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import tree_util
 
+from .. import profiler as _profiler
 from ..core import engine
+from ..core import monitor as _monitor
 from ..core.tensor import Tensor
 from ..ops import random as _random
 from . import state as _jstate
@@ -124,6 +127,18 @@ def _freeze_static(v):
 from .dy2static import source_calls_grad as _source_calls_grad  # noqa: E402
 
 
+def _telemetry_name(func):
+    """Low-cardinality but unambiguous jit counter key: the last two
+    __qualname__ components minus '<locals>', so Model.forward and
+    OtherModel.forward get distinct jit/… namespaces (bare __name__
+    aggregated every 'forward' into one counter) while module-level
+    functions keep their plain name."""
+    qn = (getattr(func, "__qualname__", None)
+          or getattr(func, "__name__", None) or "fn")
+    parts = [p for p in qn.split(".") if p != "<locals>"]
+    return ".".join(parts[-2:])
+
+
 class StaticFunction:
     """Compiled wrapper (reference: StaticFunction,
     program_translator.py:236)."""
@@ -147,6 +162,8 @@ class StaticFunction:
         self._needs_tape = _source_calls_grad(func)
         self._input_spec = input_spec
         self._compiled = {}
+        # computed once — __call__ is the per-train-step hot path
+        self._telemetry_key = _telemetry_name(func)
         functools.update_wrapper(self, func,
                                  assigned=("__name__", "__doc__"))
 
@@ -160,6 +177,7 @@ class StaticFunction:
         bound._input_spec = self._input_spec
         bound._compiled = self._compiled
         bound._needs_tape = self._needs_tape
+        bound._telemetry_key = self._telemetry_key
         functools.update_wrapper(bound, bound._func,
                                  assigned=("__name__", "__doc__"))
         return bound
@@ -203,42 +221,66 @@ class StaticFunction:
                # or a later set_max_loop_iterations() silently reuses
                # the stale compiled program
                max_loop_iterations())
+        fname = self._telemetry_key
         entry = self._compiled.get(key)
+        compile_ev = None
         if entry is None:
+            # telemetry (reference: program cache stats in
+            # program_translator): a miss triggers a fresh trace + XLA
+            # compile — spanned and timed below. The real work happens
+            # on the first jfn invocation (jax.jit is lazy), so the
+            # span/timer cover build + first call.
+            _monitor.stat_add(f"jit/{fname}/cache_miss", 1)
+            compile_ev = _profiler.RecordEvent(
+                f"jit/compile/{fname}", "JitCompile")
+            compile_ev.begin()
+            t_compile0 = _time.perf_counter()
             entry = self._build(target, params, args_treedef, tensor_pos,
                                 static_leaves, arg_sg)
             self._compiled[key] = entry
-        jfn, box = entry
-        arg_ts = [flat_args[i] for i in tensor_pos]
-        rngc = jnp.asarray(_random._rng.counter, jnp.uint32)
-        requires = engine.is_grad_enabled() and not engine.in_trace_mode() \
-            and (any(not p.stop_gradient for p in params)
-                 or any(not t.stop_gradient for t in arg_ts))
-        if requires:
-            # differentiable boundary: the compiled forward is one tape
-            # op, so loss.backward() after a @to_static forward flows
-            # grads into params/inputs (reference: ProgramTranslator
-            # builds the backward program for the whole block)
-            def kernel(pv, av, rc):
-                out_vals, new_bufs, _ = jfn(pv, av, rc)
-                return tuple(out_vals), tuple(new_bufs)
+        else:
+            _monitor.stat_add(f"jit/{fname}/cache_hit", 1)
+        try:
+            jfn, box = entry
+            arg_ts = [flat_args[i] for i in tensor_pos]
+            rngc = jnp.asarray(_random._rng.counter, jnp.uint32)
+            requires = engine.is_grad_enabled() \
+                and not engine.in_trace_mode() \
+                and (any(not p.stop_gradient for p in params)
+                     or any(not t.stop_gradient for t in arg_ts))
+            if requires:
+                # differentiable boundary: the compiled forward is one
+                # tape op, so loss.backward() after a @to_static
+                # forward flows grads into params/inputs (reference:
+                # ProgramTranslator builds the backward program for the
+                # whole block)
+                def kernel(pv, av, rc):
+                    out_vals, new_bufs, _ = jfn(pv, av, rc)
+                    return tuple(out_vals), tuple(new_bufs)
 
-            outs, buf_outs = engine.apply_op(
-                "run_program", kernel, list(params), arg_ts, rngc)
+                outs, buf_outs = engine.apply_op(
+                    "run_program", kernel, list(params), arg_ts, rngc)
+                _random._rng.counter += 1
+                for (buf, _), nv in zip(box["buf_refs"], buf_outs):
+                    buf._value = nv._value
+                return tree_util.tree_unflatten(box["treedef"],
+                                                list(outs))
+            pvals = [p._value for p in params]
+            avals = [t._value for t in arg_ts]
+            out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
             _random._rng.counter += 1
-            for (buf, _), nv in zip(box["buf_refs"], buf_outs):
-                buf._value = nv._value
-            return tree_util.tree_unflatten(box["treedef"], list(outs))
-        pvals = [p._value for p in params]
-        avals = [t._value for t in arg_ts]
-        out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
-        _random._rng.counter += 1
-        # commit buffer updates (BatchNorm stats)
-        for (buf, _), nv in zip(box["buf_refs"], new_buf_vals):
-            buf._value = nv
-        flat_out = [Tensor(v, stop_gradient=True, _internal=True)
-                    for v in out_vals]
-        return tree_util.tree_unflatten(box["treedef"], flat_out)
+            # commit buffer updates (BatchNorm stats)
+            for (buf, _), nv in zip(box["buf_refs"], new_buf_vals):
+                buf._value = nv
+            flat_out = [Tensor(v, stop_gradient=True, _internal=True)
+                        for v in out_vals]
+            return tree_util.tree_unflatten(box["treedef"], flat_out)
+        finally:
+            if compile_ev is not None:
+                compile_ev.end()
+                _monitor.stat_add(
+                    f"jit/{fname}/compile_us",
+                    int((_time.perf_counter() - t_compile0) * 1e6))
 
     def _build(self, target, params, args_treedef, tensor_pos,
                static_leaves, arg_sg=None):
@@ -601,7 +643,24 @@ class TrainStepCompiler:
         trainable, frozen, bufs = self._params_and_buffers()
         self._prepare_call(trainable, frozen, bufs)
         if self._compiled is None:
-            self._build(trainable, frozen, bufs, batch)
+            # first call traces + XLA-compiles the whole fused step:
+            # span it and record the wall time under jit/train_step/...
+            # (the per-StaticFunction counters' TrainStepCompiler
+            # sibling)
+            _monitor.stat_add("jit/train_step/cache_miss", 1)
+            t0 = _time.perf_counter()
+            with _profiler.RecordEvent("jit/compile/train_step",
+                                       "JitCompile"):
+                self._build(trainable, frozen, bufs, batch)
+                out = self._run_compiled(trainable, frozen, bufs, batch)
+            _monitor.stat_add(
+                "jit/train_step/compile_us",
+                int((_time.perf_counter() - t0) * 1e6))
+            return out
+        _monitor.stat_add("jit/train_step/cache_hit", 1)
+        return self._run_compiled(trainable, frozen, bufs, batch)
+
+    def _run_compiled(self, trainable, frozen, bufs, batch):
         pvals = {k: p._value for k, p in trainable.items()}
         fvals = {k: p._value for k, p in frozen.items()}
         bvals = {k: b._value for k, b in bufs.items()}

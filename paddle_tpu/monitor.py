@@ -1,0 +1,282 @@
+"""paddle_tpu.monitor — unified runtime telemetry hub.
+
+Three pieces (reference: platform/monitor.h StatRegistry + STAT_ADD,
+platform/profiler/ RecordEvent instrumentation, and the stat-export
+tooling around them):
+
+  * process-wide counters — re-exported from core.monitor (stat_add /
+    stat_set / registry / device_memory_stats ...), populated by the
+    instrumented layers: `op/...` (engine dispatch under
+    FLAGS_profile_ops), `jit/...` (compile cache hits/misses + wall
+    time), `comm/...` (per-collective calls/bytes/host time),
+    `io/...` (dataloader batches/bytes/ring waits), `step/...`
+    (train-loop metrics via StepTimer).
+
+  * StepTimer — per-step training metrics hub: step time, throughput,
+    loss, lr and PJRT device-memory high water, written into the
+    StatRegistry under `step/...` and mirrored as chrome-trace counter
+    (ph "C") samples whenever a Profiler is capturing, so the merged
+    host+device timeline shows memory/throughput alongside spans.
+
+  * MetricsExporter — periodic JSON-lines or Prometheus-textfile flush
+    of the full registry snapshot. Env-configurable
+    (PADDLE_MONITOR_EXPORT_PATH / _INTERVAL / _FORMAT) so long
+    benchmark and multi-host runs leave an inspectable metrics trail
+    without code changes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from .core.monitor import (  # noqa: F401 — the counter surface
+    StatValue, StatRegistry, registry, stat_add, stat_get, stat_set,
+    stat_reset, VLOG, vlog_level, device_memory_stats,
+    device_memory_in_use,
+)
+
+__all__ = [
+    "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
+    "stat_set", "stat_reset", "VLOG", "vlog_level",
+    "device_memory_stats", "device_memory_in_use", "StepTimer",
+    "MetricsExporter", "start_exporter", "stop_exporter",
+    "get_exporter", "telemetry_snapshot",
+]
+
+
+def telemetry_snapshot():
+    """Timestamped copy of the full StatRegistry — the record the
+    exporter flushes and bench.py embeds in its `extra` field."""
+    return {"ts": round(time.time(), 3), "rank": _rank(),
+            "stats": registry.snapshot()}
+
+
+def _rank():
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class StepTimer:
+    """Per-step training metrics hub (the train-loop analog of
+    STAT_ADD at every layer).
+
+    Usage (hapi.callbacks.Telemetry drives this from Model.fit):
+
+        st = StepTimer()
+        st.begin_step()
+        ...one train step...
+        st.end_step(batch_size=bs, loss=l, lr=lr)
+
+    Every end_step updates the `step/...` registry stats and — when a
+    profiler.Profiler is capturing — records counter samples that
+    export as chrome-trace ph "C" events."""
+
+    def __init__(self, window=100):
+        self._t0 = None
+        self._window = int(window)
+        self._times = []     # recent step durations (seconds)
+        self._last = {}
+
+    def begin_step(self):
+        self._t0 = time.perf_counter()
+
+    def end_step(self, batch_size=None, loss=None, lr=None):
+        now = time.perf_counter()
+        if self._t0 is None:
+            return None
+        dt = now - self._t0
+        self._t0 = None
+        self._times.append(dt)
+        if len(self._times) > self._window:
+            del self._times[:len(self._times) - self._window]
+
+        stat_add("step/count", 1)
+        stat_add("step/total_time_us", int(dt * 1e6))
+        stat_set("step/last_time_us", int(dt * 1e6))
+        throughput = None
+        if batch_size:
+            stat_add("step/samples", int(batch_size))
+            throughput = batch_size / dt if dt > 0 else 0.0
+            # gauge kept float: int() would truncate big-model runs
+            # under 1 sample/s to a stalled-looking 0
+            stat_set("step/throughput", round(throughput, 3))
+        if loss is not None:
+            # micro-units: the registry holds ints (monitor.h int64)
+            stat_set("step/last_loss_e6", int(float(loss) * 1e6))
+        if lr is not None:
+            stat_set("step/lr_e9", int(float(lr) * 1e9))
+        used, peak = device_memory_in_use()
+        if used or peak:
+            stat_set("step/device_mem_bytes_in_use", used)
+            registry.get("step/device_mem_peak_bytes").maximum(peak)
+
+        from . import profiler as _prof
+
+        if _prof.is_recording():
+            _prof.record_counter("step_time_ms", dt * 1e3, ts=now)
+            if throughput is not None:
+                _prof.record_counter("throughput", throughput, ts=now)
+            if loss is not None:
+                _prof.record_counter("loss", float(loss), ts=now)
+            if lr is not None:
+                _prof.record_counter("lr", float(lr), ts=now)
+            if used or peak:
+                _prof.record_counter("device_mem_bytes_in_use", used,
+                                     ts=now)
+        self._last = {"time_s": dt, "batch_size": batch_size,
+                      "loss": loss, "lr": lr}
+        return dt
+
+    def summary(self):
+        n = len(self._times)
+        avg = sum(self._times) / n if n else 0.0
+        out = {"steps_windowed": n, "avg_step_ms": avg * 1e3}
+        bs = self._last.get("batch_size")
+        if bs and avg > 0:
+            out["avg_throughput"] = bs / avg
+        out.update({k: v for k, v in self._last.items()
+                    if v is not None})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Metrics exporter
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_line(name, value):
+    metric = "paddle_tpu_" + _PROM_BAD.sub("_", name)
+    return f"{metric} {value}"
+
+
+class MetricsExporter:
+    """Periodic flush of the StatRegistry snapshot to a file.
+
+    fmt="jsonl" (default): append one JSON line per flush —
+        {"ts": ..., "rank": ..., "stats": {...}}
+    fmt="prom": atomically rewrite a Prometheus textfile (the
+        node-exporter textfile-collector contract: write tmp, rename).
+
+    A `{rank}` placeholder in the path expands to the trainer rank so
+    multi-host runs don't clobber one file. The background thread is a
+    daemon; stop() joins it and performs one final flush."""
+
+    def __init__(self, path, interval=30.0, fmt=None):
+        self.path = str(path).replace("{rank}", str(_rank()))
+        self.interval = float(interval)
+        if fmt is None:
+            fmt = "prom" if self.path.endswith(".prom") else "jsonl"
+        if fmt not in ("jsonl", "prom"):
+            raise ValueError(
+                f"MetricsExporter: unknown format {fmt!r} "
+                "(expected 'jsonl' or 'prom')")
+        self.fmt = fmt
+        self._stop = threading.Event()
+        self._thread = None
+
+    def flush(self):
+        snap = telemetry_snapshot()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if self.fmt == "jsonl":
+            with open(self.path, "a") as f:
+                f.write(json.dumps(snap) + "\n")
+        else:
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            lines = [_prom_line(k, v)
+                     for k, v in sorted(snap["stats"].items())]
+            lines.append(_prom_line("export_timestamp_seconds",
+                                    snap["ts"]))
+            with open(tmp, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            os.replace(tmp, self.path)
+        return snap
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:
+                # an unwritable path OR an unserializable stat value
+                # must not silently kill the exporter thread for the
+                # rest of a long run — keep trying; direct flush()
+                # callers still see the raise
+                pass
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, flush=True):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        if flush:
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+
+_exporter = None
+_exporter_lock = threading.Lock()
+
+
+def get_exporter():
+    return _exporter
+
+
+def start_exporter(path=None, interval=None, fmt=None):
+    """Start (or return) the process-wide exporter. With no arguments
+    the env contract applies: PADDLE_MONITOR_EXPORT_PATH (required —
+    returns None when unset), PADDLE_MONITOR_EXPORT_INTERVAL (seconds,
+    default 30), PADDLE_MONITOR_EXPORT_FORMAT (jsonl|prom, default by
+    extension)."""
+    global _exporter
+    path = path or os.environ.get("PADDLE_MONITOR_EXPORT_PATH")
+    if not path:
+        return None
+    if interval is None:
+        try:
+            interval = float(os.environ.get(
+                "PADDLE_MONITOR_EXPORT_INTERVAL", "30"))
+        except ValueError:
+            interval = 30.0
+    fmt = fmt or os.environ.get("PADDLE_MONITOR_EXPORT_FORMAT") or None
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.stop(flush=False)
+        _exporter = MetricsExporter(path, interval, fmt).start()
+        return _exporter
+
+
+def stop_exporter(flush=True):
+    global _exporter
+    with _exporter_lock:
+        e, _exporter = _exporter, None
+    if e is not None:
+        e.stop(flush=flush)
+
+
+# env-driven autostart: setting PADDLE_MONITOR_EXPORT_PATH is enough
+# for any run importing paddle_tpu to leave a metrics trail
+if os.environ.get("PADDLE_MONITOR_EXPORT_PATH"):
+    try:
+        start_exporter()
+    except Exception:
+        pass

@@ -15,7 +15,8 @@ import time
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "start_profiler", "stop_profiler"]
+           "start_profiler", "stop_profiler", "record_counter",
+           "is_recording"]
 
 
 class ProfilerTarget:
@@ -32,22 +33,94 @@ class ProfilerState:
     RECORD_AND_RETURN = 3
 
 
-class _Recorder(threading.local):
+class _Recorder:
+    """Process-wide span/counter recorder.
+
+    The active flag is shared by ALL threads — the previous
+    threading.local recorder silently dropped spans opened on
+    dataloader/worker threads, because each new thread saw
+    active=False. Events append to per-thread buffers (registered
+    under a lock, appended lock-free — the GIL serializes list.append)
+    and are merged at export; each event already carries its tid."""
+
     def __init__(self):
-        self.events = []
+        self._lock = threading.Lock()
         self.active = False
+        self._tls = threading.local()
+        self._buffers = []   # one event list per recording thread
+        self._counters = []  # (name, ts, value) time series (ph "C")
+
+    def start(self):
+        with self._lock:
+            self._tls = threading.local()  # drop stale thread buffers
+            self._buffers = []
+            self._counters = []
+            self.active = True
+
+    def stop(self):
+        self.active = False
+
+    def record(self, ev):
+        if not self.active:
+            return
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = []
+            self._tls.buf = buf
+            with self._lock:
+                self._buffers.append(buf)
+        buf.append(ev)
+
+    def record_counter(self, name, value, ts=None):
+        if not self.active:
+            return
+        with self._lock:
+            self._counters.append(
+                (name, ts if ts is not None else time.perf_counter(),
+                 float(value)))
+
+    def events(self):
+        """Merged snapshot of every thread's spans, sorted by begin
+        time."""
+        with self._lock:
+            bufs = list(self._buffers)
+        out = []
+        for b in bufs:
+            out.extend(list(b))
+        out.sort(key=lambda e: e[2])
+        return out
+
+    def counters(self):
+        with self._lock:
+            return list(self._counters)
 
 
 _recorder = _Recorder()
 
 
+def is_recording():
+    """True while a Profiler is capturing (any thread)."""
+    return _recorder.active
+
+
+def record_counter(name, value, ts=None):
+    """Record one sample of a numeric time series into the active
+    capture; exported as a chrome-trace counter (ph "C") event so
+    Perfetto draws it as a track alongside the spans. No-op when no
+    profiler is running."""
+    _recorder.record_counter(name, value, ts)
+
+
 class RecordEvent:
     """RAII host-event annotation (reference: platform/profiler.h
-    RecordEvent, used at every TraceOp)."""
+    RecordEvent, used at every TraceOp). `args` (a small dict of
+    scalars, e.g. {"batch_size": 32}) exports into the chrome-trace
+    event's args field."""
 
-    def __init__(self, name, event_type="UserDefined"):
+    def __init__(self, name, event_type="UserDefined", args=None):
         self.name = name
         self.event_type = event_type
+        self.args = args
         self._begin = None
 
     def begin(self):
@@ -56,10 +129,9 @@ class RecordEvent:
     def end(self):
         if self._begin is None:
             return
-        if _recorder.active:
-            _recorder.events.append(
-                (self.name, self.event_type, self._begin,
-                 time.perf_counter(), threading.get_ident()))
+        _recorder.record(
+            (self.name, self.event_type, self._begin,
+             time.perf_counter(), threading.get_ident(), self.args))
         self._begin = None
 
     def __enter__(self):
@@ -72,11 +144,17 @@ class RecordEvent:
 
 
 def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Profiler step scheduler (reference: paddle.profiler
+    make_scheduler). Cycles CLOSED->READY->RECORD(_AND_RETURN); with
+    repeat > 0 the scheduler returns CLOSED permanently after `repeat`
+    full cycles (previously the argument was accepted and ignored)."""
     def scheduler(step):
         s = step - skip_first
         if s < 0:
             return ProfilerState.CLOSED
         cycle = closed + ready + record
+        if repeat and cycle and s // cycle >= repeat:
+            return ProfilerState.CLOSED
         pos = s % cycle if cycle else 0
         if pos < closed:
             return ProfilerState.CLOSED
@@ -117,8 +195,7 @@ class Profiler:
         self._last_step_t = None
 
     def start(self):
-        _recorder.active = True
-        _recorder.events = []
+        _recorder.start()
         self._last_step_t = time.perf_counter()
         # host/device common epoch: device (XPlane) timestamps are
         # relative to trace start, so host events rebase onto the same
@@ -138,13 +215,23 @@ class Profiler:
                         opts.python_tracer_level = 0
                     except Exception:
                         opts = None
-                jax.profiler.start_trace(self._jax_dir,
-                                         profiler_options=opts)
+                if opts is not None:
+                    try:
+                        jax.profiler.start_trace(self._jax_dir,
+                                                 profiler_options=opts)
+                    except TypeError:
+                        # older jax: no profiler_options kwarg —
+                        # passing it unconditionally used to kill the
+                        # WHOLE device capture (the TypeError was
+                        # swallowed and _jax_dir nulled)
+                        jax.profiler.start_trace(self._jax_dir)
+                else:
+                    jax.profiler.start_trace(self._jax_dir)
             except Exception:
                 self._jax_dir = None
 
     def stop(self):
-        _recorder.active = False
+        _recorder.stop()
         if self._jax_dir is not None:
             try:
                 import jax
@@ -158,7 +245,29 @@ class Profiler:
     def step(self, num_samples=None):
         now = time.perf_counter()
         if self._last_step_t is not None:
-            self._step_times.append(now - self._last_step_t)
+            dt = now - self._last_step_t
+            self._step_times.append(dt)
+            # counter (ph "C") samples: the merged chrome trace shows
+            # step time / throughput / device memory as tracks next to
+            # the host spans (reference: the new profiler's
+            # MemTraceEvent counters in ChromeTracingLogger). The
+            # profiler/ prefix keeps this series on its OWN track —
+            # monitor.StepTimer emits per-train-batch samples under the
+            # bare names, and Profiler.step intervals have different
+            # semantics (whatever the user brackets between steps)
+            _recorder.record_counter("profiler/step_time_ms", dt * 1e3,
+                                     ts=now)
+            if num_samples:
+                _recorder.record_counter("profiler/throughput",
+                                         num_samples / dt, ts=now)
+            from ..core.monitor import device_memory_in_use
+
+            used, peak = device_memory_in_use()
+            if used or peak:
+                _recorder.record_counter(
+                    "profiler/device_mem_bytes_in_use", used, ts=now)
+                _recorder.record_counter(
+                    "profiler/device_mem_peak_bytes", peak, ts=now)
         self._last_step_t = now
         self._step += 1
 
@@ -170,12 +279,26 @@ class Profiler:
 
     def export(self, path, format="json"):
         epoch = getattr(self, "_epoch", 0.0)
-        events = [{
-            "name": name, "cat": cat, "ph": "X",
-            "ts": (begin - epoch) * 1e6,
-            "dur": (end - begin) * 1e6,
-            "pid": 0, "tid": tid,
-        } for name, cat, begin, end, tid in _recorder.events]
+        events = []
+        for name, cat, begin, end, tid, eargs in _recorder.events():
+            ev = {
+                "name": name, "cat": cat, "ph": "X",
+                "ts": (begin - epoch) * 1e6,
+                "dur": (end - begin) * 1e6,
+                "pid": 0, "tid": tid,
+            }
+            if eargs:
+                ev["args"] = dict(eargs)
+            events.append(ev)
+        # counter (ph "C") tracks: step time, throughput, device memory
+        # samples recorded via record_counter fold into the SAME
+        # timeline so Perfetto draws them alongside the spans
+        events.extend({
+            "name": name, "ph": "C",
+            "ts": (ts - epoch) * 1e6,
+            "pid": 0,
+            "args": {"value": value},
+        } for name, ts, value in _recorder.counters())
         # merged host+device timeline (reference: the new profiler's
         # EventNode trees combining HostTracer + CudaTracer into ONE
         # chrome trace): fold the XLA/device events jax.profiler
@@ -213,7 +336,7 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         agg = {}
-        for name, _, b, e, _ in _recorder.events:
+        for name, _, b, e, _, _a in _recorder.events():
             tot, cnt = agg.get(name, (0.0, 0))
             agg[name] = (tot + (e - b), cnt + 1)
         lines = [f"{'Event':40s} {'Calls':>8s} {'Total(ms)':>12s}"]
