@@ -507,6 +507,13 @@ def main():
         from paddle_tpu import monitor as _monitor
 
         results["telemetry"] = _monitor.telemetry_snapshot()
+        # lint-cleanliness of the run, called out separately from the
+        # full snapshot: analysis/<code>/findings counters say whether
+        # the benchmarked programs tripped any PTA diagnostics (ISSUE
+        # 2), so the perf trajectory records clean-vs-dirty runs
+        results["analysis"] = {
+            k: v for k, v in results["telemetry"]["stats"].items()
+            if k.startswith("analysis/")}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

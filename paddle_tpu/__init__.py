@@ -58,6 +58,7 @@ from . import onnx
 from . import sparse
 from . import quantization
 from . import cost_model
+from . import analysis
 from . import utils
 from . import linalg as _linalg_ns
 from . import fft
@@ -134,7 +135,8 @@ def _register_tensor_methods():
     Tensor.__mul__ = lambda s, o: m.multiply(s, o)
     Tensor.__rmul__ = lambda s, o: m.multiply(s, o)
     Tensor.__truediv__ = lambda s, o: m.divide(s, o)
-    Tensor.__rtruediv__ = lambda s, o: m.divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__rtruediv__ = lambda s, o: m.divide(
+        o if isinstance(o, Tensor) else to_tensor(o), s)
     Tensor.__floordiv__ = lambda s, o: m.floor_divide(s, o)
     Tensor.__mod__ = lambda s, o: m.mod(s, o)
     Tensor.__pow__ = lambda s, o: m.pow(s, o)

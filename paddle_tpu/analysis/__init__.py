@@ -1,0 +1,166 @@
+"""paddle_tpu.analysis — program diagnostics.
+
+The static-analysis subsystem over the repo's three program surfaces
+(the reference's fluid/framework/ir inspection layer + dy2static
+error reporting, rebuilt around TPU failure modes: recompile storms,
+dtype upcasts, const-capture bloat, cross-rank collective skew):
+
+  * jaxpr analyzers  — abstract-trace a function (`jax.make_jaxpr`)
+    and lint dtype flow, captured constants, dead computation, tracer
+    leaks, static-arg recompile hazards       (analysis/jaxpr.py)
+  * Program-IR passes — read-only `AnalysisPass`es over
+    Program/Block/OpRecord                    (analysis/program.py)
+  * collective checker — per-rank digest comparison of the traced
+    comm-op sequence                          (analysis/collectives.py)
+  * dy2static preflight — AST lint before tracing
+                                              (analysis/preflight.py)
+
+Entry points:
+  * `check(fn, input_spec=...)` — programmatic, returns a `Report`
+  * `python -m paddle_tpu.analysis <file|dir|module>` — CLI, exits
+    nonzero on error-severity findings
+  * `PADDLE_ANALYSIS=1` — opt-in trace-time hook: to_static /
+    TrainStepCompiler builds run the checks and surface findings (to
+    stderr + `analysis/<code>/findings` monitor counters) without
+    changing the traced program
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from .diagnostics import (DIAGNOSTICS, Finding, Report, Severity,
+                          is_suppressed)
+from .jaxpr import (analyze_consts, analyze_dead, analyze_dtypes,
+                    analyze_static_args, analyze_tracer_leaks,
+                    fn_anchor, trace_program)
+from .collectives import (check_collectives, collect_comm_ops,
+                          comm_digest, compare_comm_digests)
+from .preflight import preflight, preflight_source
+from .program import (DeadVarAnalysisPass, OpCoverageAnalysisPass,
+                      UnfetchedOutputAnalysisPass, analyze_program)
+
+__all__ = [
+    "DIAGNOSTICS", "Finding", "Report", "Severity", "check",
+    "enabled", "trace_build_hook", "preflight", "preflight_source",
+    "analyze_program", "check_collectives", "trace_program",
+    "DeadVarAnalysisPass", "UnfetchedOutputAnalysisPass",
+    "OpCoverageAnalysisPass", "is_suppressed", "fn_anchor",
+    "collect_comm_ops", "comm_digest", "compare_comm_digests",
+]
+
+
+def check(fn, input_spec=None, example=None, static_args=None,
+          const_bytes_threshold=1 << 20, collectives=True,
+          record=True):
+    """Run the full diagnostic suite over one callable.
+
+    * always: dy2static AST preflight of `fn`'s source
+    * with `input_spec` (list[jit.InputSpec]) or `example`
+      ((args, kwargs) with Tensor leaves): abstract-trace and run the
+      jaxpr analyzers + (with `collectives`) the collective checker
+    * `static_args`: extra non-tensor call arguments to classify for
+      recompile hazards (the `example` form analyzes its own
+      non-tensor leaves automatically)
+
+    Returns a `Report`; `record=True` also feeds the
+    `analysis/<code>/findings` monitor counters.
+    """
+    report = Report()
+    preflight(fn, report)
+    anchor = fn_anchor(fn)
+    if input_spec is not None or example is not None:
+        tp = trace_program(fn, input_spec=input_spec, example=example)
+        analyze_dtypes(tp, report)
+        analyze_consts(tp, report, threshold=const_bytes_threshold)
+        analyze_dead(tp, report)
+        analyze_tracer_leaks(tp, report)
+        analyze_static_args(tp.statics, report, anchor=tp.anchor)
+        if collectives:
+            # "local": collect + fingerprint but never gather — the
+            # deadlock-free mode for hooks, where not every rank is
+            # guaranteed to reach this call (see check_collectives)
+            check_collectives(tp, report,
+                              exchange=collectives != "local")
+    if static_args is not None:
+        statics = (list(static_args.values())
+                   if isinstance(static_args, dict)
+                   else list(static_args))
+        analyze_static_args(statics, report, anchor=anchor)
+    _drop_suppressed(report)
+    if record:
+        report.record()
+    return report
+
+
+def _drop_suppressed(report):
+    """Honor `# noqa: PTA0xx` on the anchored source line for the
+    programmatic path too (the CLI filters its own) — a deliberately
+    suppressed, accepted finding must not re-print on every build or
+    dirty the analysis/<code>/findings counters."""
+    import linecache
+
+    report.findings = [
+        f for f in report.findings
+        if not (f.file and f.line
+                and is_suppressed(f, linecache.getline(f.file,
+                                                       f.line)))]
+    return report
+
+
+def enabled():
+    """True when the PADDLE_ANALYSIS env opt-in is on."""
+    return os.environ.get("PADDLE_ANALYSIS", "").strip().lower() \
+        not in ("", "0", "false", "off")
+
+
+def trace_build_hook(fn, args=(), kwargs=None, where="",
+                     arrays_as_tensors=False):
+    """Best-effort analysis at jit build time (to_static cache miss /
+    TrainStepCompiler first call), gated on `enabled()`. Never raises
+    and never touches the traced program — findings go to stderr and
+    the monitor counters; failures tick `analysis/hook_errors`.
+
+    `arrays_as_tensors` mirrors the call site's contract: a to_static
+    call treats raw ndarrays as STATIC args (they must stay raw here
+    so analyze_static_args classifies the recompile hazard exactly as
+    jit's _freeze_static_ex would key it), while TrainStepCompiler
+    places every batch element on device as a traced input."""
+    if not enabled():
+        return None
+    from ..core import monitor as _monitor
+    from ..core.tensor import Tensor
+
+    try:
+        import jax.numpy as jnp
+
+        def as_tensor(a):
+            # mirrors _place_batch exactly: EVERY batch element —
+            # arrays and Python scalars alike — is placed on device
+            # as a traced input, so none of them is a static-arg
+            # recompile hazard
+            if not arrays_as_tensors or isinstance(a, Tensor):
+                return a
+            try:
+                return Tensor(jnp.asarray(a), stop_gradient=True,
+                              _internal=True)
+            except Exception:
+                return a
+
+        ex_args = tuple(as_tensor(a) for a in args)
+        ex_kwargs = {k: as_tensor(v) for k, v in (kwargs or {}).items()}
+        report = check(fn, example=(ex_args, ex_kwargs),
+                       collectives="local")
+        if report.findings:
+            name = getattr(fn, "__qualname__", None) or \
+                getattr(fn, "__name__", None) or type(fn).__name__
+            print(f"[paddle_tpu.analysis] {where or name}:",
+                  file=sys.stderr)
+            for f in report.sorted():
+                print(f"  {f.format()}", file=sys.stderr)
+        return report
+    except Exception as e:
+        _monitor.stat_add("analysis/hook_errors", 1)
+        _monitor.VLOG(1, f"analysis hook failed in {where}: "
+                         f"{type(e).__name__}: {e}")
+        return None

@@ -1,0 +1,6 @@
+"""`python -m paddle_tpu.analysis` entry point."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

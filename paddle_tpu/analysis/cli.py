@@ -1,0 +1,108 @@
+"""CLI: `python -m paddle_tpu.analysis <file|dir|module> ...`
+
+AST-surface lint (the dy2static preflight) over source files — no
+import of the target, no trace, so it runs on anything, fast. Exit
+status is the error-count truth: nonzero iff any error-severity
+finding survives `# noqa: PTA0xx` suppression. The deeper jaxpr/
+collective analyzers need shapes, so they run through the
+programmatic `analysis.check(fn, input_spec=...)` or the
+`PADDLE_ANALYSIS=1` trace-time hook instead.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+from .diagnostics import Report, Severity, is_suppressed
+from .preflight import preflight_source
+
+__all__ = ["main", "iter_target_files", "lint_file"]
+
+
+def iter_target_files(target):
+    """Resolve a CLI target to .py files: an existing file, a
+    directory (recursive), or an importable module/package name."""
+    if os.path.isfile(target):
+        return [target]
+    if os.path.isdir(target):
+        out = []
+        for root, _dirs, files in os.walk(target):
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+        return out
+    try:
+        spec = importlib.util.find_spec(target)
+    except (ImportError, ValueError, ModuleNotFoundError):
+        spec = None
+    if spec is None or not spec.origin:
+        raise FileNotFoundError(
+            f"{target!r} is neither a file, a directory, nor an "
+            "importable module")
+    if spec.submodule_search_locations:
+        return iter_target_files(os.path.dirname(spec.origin))
+    return [spec.origin]
+
+
+def lint_file(path, report=None, traced_only=True):
+    """Preflight one file, applying `# noqa` line suppression."""
+    report = report if report is not None else Report()
+    with open(path, encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    lines = source.splitlines()
+    raw = preflight_source(source, filename=path,
+                           traced_only=traced_only)
+    for finding in raw.findings:
+        text = (lines[finding.line - 1]
+                if finding.line and finding.line <= len(lines) else "")
+        if not is_suppressed(finding, text):
+            report.findings.append(finding)
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu program diagnostics (PTA0xx codes)")
+    ap.add_argument("targets", nargs="+",
+                    help=".py file, directory, or module name")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    ap.add_argument("--all-functions", action="store_true",
+                    help="treat every function as a trace candidate "
+                         "(default: @to_static + forward only)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress info-severity findings in output")
+    args = ap.parse_args(argv)
+
+    report = Report()
+    nfiles = 0
+    for target in args.targets:
+        try:
+            files = iter_target_files(target)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        for path in files:
+            nfiles += 1
+            lint_file(path, report,
+                      traced_only=not args.all_functions)
+
+    shown = [f for f in report.sorted()
+             if not (args.quiet and f.severity == Severity.INFO)]
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in shown],
+            "files": nfiles, "summary": report.summary()}))
+    else:
+        for f in shown:
+            print(f.format())
+        print(f"checked {nfiles} file(s): {report.summary()}")
+    report.record()
+    if args.strict and report.warnings:
+        return 1
+    return report.exit_code

@@ -1,0 +1,233 @@
+"""Collective-consistency checker.
+
+On TPU a cross-rank mismatch in the collective op sequence — a
+different op order, a shape/dtype disagreement, a group skew — does
+not error: the slice HANGS until the job is killed (EQuARX: XLA
+collectives demand exact op/layout agreement). This checker makes the
+failure mode a per-rank diagnostic instead:
+
+  1. walk the traced program (recursively through pjit/shard_map/
+     cond/scan sub-jaxprs) for comm primitives
+     (`distributed.collective.COMM_PRIMITIVE_NAMES`),
+  2. fold each op's (name, axes, shapes, dtypes, params) into a
+     fixed-size uint32 digest vector: [count, total, per-op hashes],
+  3. exchange digests with ONE eager `all_gather` (a fixed-shape
+     payload that cannot itself deadlock on program shape), and
+  4. compare against the majority digest, reporting PTA020 per
+     divergent rank with the local op index where histories fork.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..distributed.collective import COMM_PRIMITIVE_NAMES
+from .diagnostics import Report
+from .jaxpr import TracedProgram, eqn_anchor, iter_eqns
+
+__all__ = ["CommOp", "collect_comm_ops", "comm_digest",
+           "compare_comm_digests", "check_collectives", "DIGEST_SLOTS"]
+
+# per-op hash slots in the digest vector; programs with more comm ops
+# than this still compare (the total-hash slot covers the tail)
+DIGEST_SLOTS = 64
+
+
+class CommOp:
+    """One comm primitive occurrence in the traced program."""
+
+    __slots__ = ("name", "axes", "shapes", "dtypes", "params", "file",
+                 "line")
+
+    def __init__(self, name, axes, shapes, dtypes, params, file=None,
+                 line=None):
+        self.name = name
+        self.axes = axes
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.params = params
+        self.file = file
+        self.line = line
+
+    def descriptor(self):
+        """Canonical string every rank must agree on."""
+        return (f"{self.name}|axes={self.axes}|shapes={self.shapes}"
+                f"|dtypes={self.dtypes}|{self.params}")
+
+    def __repr__(self):
+        return f"<CommOp {self.descriptor()}>"
+
+
+def _eqn_axes(eqn):
+    p = eqn.params
+    axes = p.get("axes", p.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+_HASH_PARAMS = ("perm", "axis_index_groups", "split_axis",
+                "concat_axis", "all_gather_dimension", "axis_size",
+                "tiled", "scatter_dimension")
+
+
+def collect_comm_ops(closed_or_tp):
+    """All comm-primitive eqns in trace order, sub-jaxprs included —
+    trace order is exactly the issue order every rank must share."""
+    closed = (closed_or_tp.closed
+              if isinstance(closed_or_tp, TracedProgram)
+              else closed_or_tp)
+    default = (closed_or_tp.anchor
+               if isinstance(closed_or_tp, TracedProgram)
+               else (None, None))
+    ops = []
+    for eqn in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name not in COMM_PRIMITIVE_NAMES:
+            continue
+        shapes = tuple(tuple(getattr(v.aval, "shape", ()))
+                       for v in eqn.invars)
+        dtypes = tuple(str(getattr(v.aval, "dtype", ""))
+                       for v in eqn.invars)
+        params = tuple(sorted(
+            (k, str(v)) for k, v in eqn.params.items()
+            if k in _HASH_PARAMS))
+        file, line = eqn_anchor(eqn, default)
+        ops.append(CommOp(eqn.primitive.name, _eqn_axes(eqn), shapes,
+                          dtypes, params, file=file, line=line))
+    return ops
+
+
+def _h32(text):
+    return np.uint32(int.from_bytes(
+        hashlib.sha256(text.encode()).digest()[:4], "little"))
+
+
+def comm_digest(ops, slots=DIGEST_SLOTS):
+    """uint32[slots + 2]: [op count, total hash, first `slots` per-op
+    hashes] — fixed shape so the exchange itself can't shape-mismatch."""
+    vec = np.zeros(slots + 2, np.uint32)
+    vec[0] = np.uint32(len(ops) & 0xFFFFFFFF)
+    descs = [op.descriptor() for op in ops]
+    vec[1] = _h32("\n".join(descs))
+    for i, d in enumerate(descs[:slots]):
+        vec[2 + i] = _h32(f"{i}:{d}")
+    return vec
+
+
+def compare_comm_digests(gathered, rank, local_ops, report=None,
+                         anchor=(None, None)):
+    """Compare this rank's digest against all ranks' (`gathered`:
+    [world, slots+2] uint32). Emits PTA020 per divergent rank — from
+    EVERY rank's perspective, so each rank's log names the index where
+    ITS history forks from the consensus."""
+    report = report if report is not None else Report()
+    g = np.asarray(gathered, np.uint32)
+    totals = [tuple(row[:2]) for row in g]
+    # consensus = most common (count, total-hash) pair
+    counts = {}
+    for t in totals:
+        counts[t] = counts.get(t, 0) + 1
+    consensus = max(counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+    bad_ranks = [r for r, t in enumerate(totals) if t != consensus]
+    if not bad_ranks:
+        return report
+    cons_row = g[totals.index(consensus)]
+    file, line = anchor
+    for r in bad_ranks:
+        row = g[r]
+        # first per-op slot where this rank forks from consensus
+        fork = next((i for i in range(2, g.shape[1])
+                     if row[i] != cons_row[i]), None)
+        idx = fork - 2 if fork is not None else None
+        if r == rank:
+            local_desc = (local_ops[idx].descriptor()
+                          if idx is not None and idx < len(local_ops)
+                          else "<op beyond local program>")
+            if (idx is not None and idx < len(local_ops)
+                    and local_ops[idx].file):
+                file, line = (local_ops[idx].file,
+                              local_ops[idx].line)
+            report.add(
+                "PTA020",
+                f"rank {r} (this rank) traced {row[0]} collective "
+                f"op(s) but the consensus program has "
+                f"{cons_row[0]}; histories fork at op index "
+                f"{idx} — local op there: {local_desc}. An "
+                "uncorrected run would hang the slice at this "
+                "collective",
+                file=file, line=line, analyzer="collective")
+        else:
+            report.add(
+                "PTA020",
+                f"rank {r} diverges from the consensus collective "
+                f"program ({row[0]} vs {cons_row[0]} op(s), fork at "
+                f"op index {idx}) — see that rank's report for its "
+                "local op",
+                file=file, line=line, analyzer="collective")
+    return report
+
+
+def check_collectives(tp: TracedProgram, report=None, group=None,
+                      exchange=True):
+    """Full check over a TracedProgram: collect ops, and when running
+    multi-process exchange digests with one eager all_gather; single
+    process records an informational PTA021 (nothing to compare).
+
+    `exchange=False` is the DEADLOCK-FREE mode the PADDLE_ANALYSIS
+    build hook uses: the digest all_gather itself requires every rank
+    to participate, but build hooks fire on per-rank cache misses and
+    swallow per-rank analysis errors, so participation there is not
+    guaranteed — a peerless gather would hang exactly like the bug
+    this checker hunts. Instead each rank logs its digest fingerprint
+    (PTA021 info); operators diff the per-rank lines. Programmatic
+    `check()` keeps the exchange: the caller's script invokes it at
+    the same point on every rank."""
+    from ..distributed import collective as coll
+
+    report = report if report is not None else Report()
+    ops = collect_comm_ops(tp)
+    anchor = (tp.anchor if isinstance(tp, TracedProgram)
+              else (None, None))
+    op_anchor = ((ops[0].file, ops[0].line) if ops else anchor)
+    nprocs = coll._nprocs()
+    if nprocs <= 1 or not exchange:
+        if not ops:
+            return report
+        if nprocs <= 1:
+            report.add(
+                "PTA021",
+                f"traced program issues {len(ops)} collective op(s) "
+                f"(first: {ops[0].name} on axes {ops[0].axes}); "
+                "single process — no peers to compare against",
+                file=op_anchor[0], line=op_anchor[1],
+                analyzer="collective")
+        else:
+            digest = comm_digest(ops)
+            report.add(
+                "PTA021",
+                f"rank {coll._proc_index()}: {len(ops)} collective "
+                f"op(s), digest {int(digest[1]):08x} — no cross-rank "
+                "exchange in hook mode (diff this line across rank "
+                "logs, or call analysis.check(..., collectives=True) "
+                "at the same point on every rank for the compared "
+                "verdict)",
+                file=op_anchor[0], line=op_anchor[1],
+                analyzer="collective")
+        return report
+    # exchange mode: EVERY rank joins the gather — including one that
+    # traced zero comm ops (its digest is the empty-sequence vector).
+    # Skipping here would hang the peers inside the digest exchange,
+    # the exact asymmetric-participation deadlock this checker hunts.
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    digest = comm_digest(ops)
+    gathered = []
+    coll.all_gather(gathered,
+                    Tensor(jnp.asarray(digest), stop_gradient=True,
+                           _internal=True), group=group)
+    rows = np.stack([np.asarray(t._value if isinstance(t, Tensor)
+                                else t, np.uint32) for t in gathered])
+    return compare_comm_digests(rows, coll._proc_index(), ops,
+                                report=report, anchor=op_anchor)

@@ -1,0 +1,206 @@
+"""Diagnostic framework: codes, severities, Finding/Report objects.
+
+Parity target: the reference's dy2static error-reporting machinery
+(dygraph_to_static/error.py ErrorData + the pass inspection helpers in
+fluid/framework/ir) — but organized like a linter: every analyzer emits
+structured `Finding`s carrying a stable `PTA0xx` code, a severity, a
+human message and a `file:line` anchor, collected into a `Report` whose
+error count drives the CLI exit status and whose `record()` feeds the
+PR-1 monitor registry (`analysis/<code>/findings` counters).
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["Severity", "Finding", "Report", "DIAGNOSTICS",
+           "severity_rank", "is_suppressed"]
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+_SEV_RANK = {Severity.ERROR: 2, Severity.WARNING: 1, Severity.INFO: 0}
+
+
+def severity_rank(sev):
+    return _SEV_RANK.get(sev, 0)
+
+
+# code -> (default severity, title, typical fix). The README table is
+# generated from the same facts — keep the two in sync.
+DIAGNOSTICS = {
+    "PTA001": (Severity.ERROR,
+               "float64 in traced program",
+               "cast to float32/bfloat16 (TPU has no fast f64 path)"),
+    "PTA002": (Severity.WARNING,
+               "implicit low->high precision promotion",
+               "match operand dtypes; check amp lists for the upcast"),
+    "PTA003": (Severity.WARNING,
+               "large host constant baked into traced program",
+               "pass the array as an input or Parameter, not a capture"),
+    "PTA004": (Severity.WARNING,
+               "dead computation: op results unused by any output",
+               "drop the computation or return/fetch its result"),
+    "PTA005": (Severity.ERROR,
+               "tracer leaked out of the traced function",
+               "don't store intermediates in globals/closures/attrs"),
+    "PTA006": (Severity.WARNING,
+               "recompile hazard in a static argument",
+               "make the arg hashable, or pass it as a traced tensor"),
+    "PTA010": (Severity.WARNING,
+               "dead op in Program IR",
+               "remove it or run the dead_op_elimination pass"),
+    "PTA011": (Severity.WARNING,
+               "program output produced but never fetched/consumed",
+               "fetch the variable or drop the producing op"),
+    "PTA012": (Severity.INFO,
+               "op coverage report",
+               "informational: op-type histogram of the program"),
+    "PTA020": (Severity.ERROR,
+               "collective program mismatch across ranks",
+               "make every rank trace the same comm ops/shapes/order"),
+    "PTA021": (Severity.INFO,
+               "collective check ran without peers",
+               "informational: single-process trace, nothing compared"),
+    "PTA030": (Severity.WARNING,
+               "print in traced code runs at device-execution time",
+               "use jax.debug.print semantics knowingly, or log outside"),
+    "PTA031": (Severity.ERROR,
+               "in-place container mutation in a traced loop",
+               "use the functional form (append statement / TensorArray)"),
+    "PTA032": (Severity.WARNING,
+               "loop may hit max_loop_iterations truncation",
+               "raise set_max_loop_iterations or bound the loop"),
+    "PTA033": (Severity.ERROR,
+               "construct dy2static cannot convert",
+               "rewrite (no for/else, while/else, return/break in "
+               "try/with under control flow); else trace-only applies"),
+    "PTA034": (Severity.WARNING,
+               "host sync (.numpy()/.item()) in traced code",
+               "keep values on device; sync only outside the step"),
+}
+
+
+class Finding:
+    """One diagnostic: code + severity + message + file:line anchor."""
+
+    __slots__ = ("code", "severity", "message", "file", "line",
+                 "analyzer")
+
+    def __init__(self, code, message, file=None, line=None,
+                 severity=None, analyzer=""):
+        self.code = code
+        self.severity = severity or DIAGNOSTICS.get(
+            code, (Severity.WARNING,))[0]
+        self.message = message
+        self.file = file
+        self.line = line
+        self.analyzer = analyzer
+
+    @property
+    def anchor(self):
+        if self.file:
+            return (f"{self.file}:{self.line}" if self.line
+                    else str(self.file))
+        return "<unknown>"
+
+    def format(self):
+        return (f"{self.anchor}: {self.code} {self.severity}: "
+                f"{self.message}")
+
+    def to_dict(self):
+        return {"code": self.code, "severity": self.severity,
+                "message": self.message, "file": self.file,
+                "line": self.line, "analyzer": self.analyzer}
+
+    def __repr__(self):
+        return f"<Finding {self.format()}>"
+
+
+# `# noqa: PTA001` (ruff/flake8 convention) or `# pta: disable=PTA001`
+_NOQA = re.compile(
+    r"#\s*(?:noqa:\s*(?P<codes>[A-Z0-9, ]+)|noqa\b(?!:)"
+    r"|pta:\s*disable=(?P<codes2>[A-Z0-9, ]+))")
+
+
+def is_suppressed(finding, line_text):
+    """True when the source line carries a suppression comment for
+    this finding's code (bare `# noqa` suppresses everything)."""
+    m = _NOQA.search(line_text or "")
+    if not m:
+        return False
+    codes = m.group("codes") or m.group("codes2")
+    if codes is None:
+        return True  # bare noqa
+    listed = {c.strip() for c in codes.replace(",", " ").split()}
+    return finding.code in listed
+
+
+class Report:
+    """Ordered finding collection + the CLI/monitor contract."""
+
+    def __init__(self):
+        self.findings = []
+
+    def add(self, code, message, file=None, line=None, severity=None,
+            analyzer=""):
+        f = Finding(code, message, file=file, line=line,
+                    severity=severity, analyzer=analyzer)
+        self.findings.append(f)
+        return f
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+        return self
+
+    def by_severity(self, sev):
+        return [f for f in self.findings if f.severity == sev]
+
+    @property
+    def errors(self):
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self):
+        return self.by_severity(Severity.WARNING)
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    @property
+    def exit_code(self):
+        return 1 if self.errors else 0
+
+    def codes(self):
+        return sorted({f.code for f in self.findings})
+
+    def sorted(self):
+        return sorted(
+            self.findings,
+            key=lambda f: (f.file or "", f.line or 0,
+                           -severity_rank(f.severity), f.code))
+
+    def summary(self):
+        return (f"{len(self.errors)} error(s), "
+                f"{len(self.warnings)} warning(s), "
+                f"{len(self.by_severity(Severity.INFO))} info "
+                f"in {len(self.findings)} finding(s)")
+
+    def format(self):
+        lines = [f.format() for f in self.sorted()]
+        lines.append(self.summary())
+        return "\n".join(lines)
+
+    def record(self):
+        """Feed the monitor registry: analysis/<code>/findings per
+        finding + one analysis/checks tick (the PR-1 counter hub)."""
+        from ..core import monitor as _monitor
+
+        _monitor.stat_add("analysis/checks", 1)
+        for f in self.findings:
+            _monitor.stat_add(f"analysis/{f.code}/findings", 1)
+        return self

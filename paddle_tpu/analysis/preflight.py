@@ -1,0 +1,201 @@
+"""dy2static AST preflight: lint a function (or a whole source file)
+for constructs the `jit/dy2static.py` converter handles lossily or not
+at all — BEFORE tracing, where the fix is cheapest.
+
+Rules (codes in diagnostics.DIAGNOSTICS):
+  PTA033  constructs `ast_transform` refuses (for/else, while/else,
+          return/break/continue through try/with under control flow) —
+          the function silently degrades to trace-only conversion, so
+          data-dependent control flow inside it crashes at trace time.
+          The refusal list itself lives in
+          `dy2static.unsupported_constructs` (single source of truth).
+  PTA031  in-place container mutation inside a `while` body: the loop
+          transformer only rewrites the `lst.append(v)` STATEMENT
+          form; extend/insert/pop/remove/del/subscript-stores mutate a
+          Python object a traced carry cannot thread.
+  PTA032  `while` loops when a max_loop_iterations bound is active:
+          the bounded-scan lowering silently freezes the carry past
+          the bound (see dy2static.last_loop_truncated).
+  PTA030  print() in traced code: converted to a run-time debug print
+          whose ordering/frequency differs from eager Python.
+  PTA034  .numpy()/.item()/.tolist() host syncs: trace breakers.
+  PTA001  'float64'/'double' dtype strings: TPU-hostile wide dtype.
+
+File mode (`preflight_source`) only applies the rules to functions
+that will plausibly be traced — `@to_static`-decorated functions and
+`forward` methods — so ordinary Python in the same module doesn't
+drown the signal. `preflight(fn)` treats its target as traced.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+from ..jit.dy2static import max_loop_iterations, unsupported_constructs
+from .diagnostics import Report, Severity
+
+__all__ = ["preflight", "preflight_source"]
+
+_MUTATORS = ("extend", "insert", "pop", "remove", "clear", "sort",
+             "reverse", "update", "setdefault")
+_HOST_SYNC = ("numpy", "item", "tolist")
+_WIDE_DTYPES = ("float64", "double", "complex128")
+
+
+def _is_to_static_decorated(fdef):
+    for d in fdef.decorator_list:
+        expr = d.func if isinstance(d, ast.Call) else d
+        name = (expr.attr if isinstance(expr, ast.Attribute)
+                else expr.id if isinstance(expr, ast.Name) else None)
+        if name == "to_static":
+            return True
+    return False
+
+
+def _walk_no_nested_defs(node):
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _check_while_body_mutation(wnode, report, filename, offset):
+    """PTA031 inside ONE while body (traced-loop candidate): flag the
+    in-place mutations the loop transformer cannot thread."""
+    for n in _walk_no_nested_defs(wnode):
+        line = getattr(n, "lineno", wnode.lineno) + offset
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _MUTATORS):
+            report.add(
+                "PTA031",
+                f".{n.func.attr}() mutates a container in place "
+                "inside a while loop — a traced loop carry cannot "
+                "thread the mutation; rebind functionally (the "
+                "`lst.append(v)` statement form / TensorArray)",
+                file=filename, line=line, analyzer="preflight")
+        elif isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    report.add(
+                        "PTA031",
+                        "subscript store mutates a container in "
+                        "place inside a while loop — use a "
+                        "TensorArray / functional update so the "
+                        "traced carry sees it",
+                        file=filename, line=line,
+                        analyzer="preflight")
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    report.add(
+                        "PTA031",
+                        "del container[i] inside a while loop is an "
+                        "in-place mutation a traced carry cannot "
+                        "thread",
+                        file=filename, line=line,
+                        analyzer="preflight")
+
+
+def _check_traced_function(fdef, report, filename, offset=0):
+    """All traced-context rules over one FunctionDef."""
+    for reason, lineno in unsupported_constructs(fdef):
+        report.add(
+            "PTA033",
+            f"{reason} — ast_transform refuses it, so the whole "
+            "function degrades to trace-only conversion (its "
+            "data-dependent control flow will fail at trace time)",
+            file=filename, line=lineno + offset, analyzer="preflight")
+    bound = max_loop_iterations()
+    for n in _walk_no_nested_defs(fdef):
+        line = getattr(n, "lineno", fdef.lineno) + offset
+        if isinstance(n, ast.While):
+            if bound:
+                report.add(
+                    "PTA032",
+                    "while loop under an active "
+                    f"max_loop_iterations={bound} bound: a traced "
+                    "condition lowers to a bounded scan that "
+                    "silently freezes the carry past the bound "
+                    "(check dy2static.last_loop_truncated())",
+                    file=filename, line=line, analyzer="preflight")
+            _check_while_body_mutation(n, report, filename, offset)
+        elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "print"):
+            report.add(
+                "PTA030",
+                "print() in traced code becomes a device-side debug "
+                "print: it fires at RUN time, once per execution, in "
+                "compiled order — not at trace time in Python order",
+                file=filename, line=line, analyzer="preflight")
+        elif (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _HOST_SYNC and not n.args
+                and not n.keywords):
+            report.add(
+                "PTA034",
+                f".{n.func.attr}() forces a host sync and breaks "
+                "under tracing (trace_mode blocks it) — keep the "
+                "value on device inside compiled code",
+                file=filename, line=line, analyzer="preflight")
+        elif (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and n.value in _WIDE_DTYPES):
+            report.add(
+                "PTA001",
+                f"dtype string {n.value!r} in traced code — TPU has "
+                "no fast float64 path; use float32/bfloat16",
+                file=filename, line=line, severity=Severity.WARNING,
+                analyzer="preflight")
+    return report
+
+
+def preflight(fn, report=None):
+    """Programmatic preflight of one callable (treated as traced)."""
+    report = report if report is not None else Report()
+    target = getattr(fn, "dygraph_function", fn)
+    target = getattr(target, "forward", target)
+    target = getattr(target, "__func__", target)
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+        filename = inspect.getsourcefile(target)
+        _, first_line = inspect.getsourcelines(target)
+    except (OSError, TypeError, SyntaxError):
+        return report  # no source — nothing to lint
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return report
+    # re-anchor: the dedented parse counts from 1; the file doesn't
+    return _check_traced_function(fdef, report, filename,
+                                  offset=first_line - 1)
+
+
+def preflight_source(source, filename="<string>", report=None,
+                     traced_only=True):
+    """Lint a whole source file. With traced_only (the CLI default)
+    the traced-context rules apply to @to_static functions and
+    `forward` methods; with traced_only=False every function is
+    treated as a trace candidate."""
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        report.add("PTA033", f"file does not parse: {e.msg}",
+                   file=filename, line=e.lineno,
+                   analyzer="preflight")
+        return report
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if (not traced_only or _is_to_static_decorated(node)
+                or node.name == "forward"):
+            _check_traced_function(node, report, filename)
+    return report

@@ -1,0 +1,132 @@
+"""Program-IR analysis passes: read-only reports over the static
+`Program`/`Block`/`OpRecord` graph (the inspection half of the
+reference's fluid/framework/ir pass family), built on the same
+`live_op_slice` the mutating `DeadOpEliminationPass` uses — the two
+views of liveness can't drift.
+
+Registered in the ordinary pass registry, so
+`apply_pass(prog, "dead_var_analysis")` composes with rewrite
+pipelines (and, being `AnalysisPass`es, skips the replay-cache
+version bump)."""
+from __future__ import annotations
+
+from collections import Counter
+
+from ..core.tensor import Tensor
+from ..static.passes import AnalysisPass, live_op_slice, register_pass
+from .diagnostics import Finding, Severity
+
+__all__ = ["DeadVarAnalysisPass", "UnfetchedOutputAnalysisPass",
+           "OpCoverageAnalysisPass", "analyze_program"]
+
+
+def _terminal_vars(program):
+    """Vars produced in the global block that no global-block op
+    consumes — the natural fetch candidates (analysis fallback roots
+    when the program has no loss/fetch context)."""
+    blk = program.global_block()
+    consumed = set()
+    for op in blk.ops:
+        consumed.update(id(leaf) for leaf in op.in_leaves
+                        if isinstance(leaf, Tensor))
+    out = []
+    for op in blk.ops:
+        out.extend(v for v in op.out_vars if id(v) not in consumed)
+    return out
+
+
+@register_pass("dead_var_analysis")
+class DeadVarAnalysisPass(AnalysisPass):
+    """PTA010: ops outside the liveness slice. Unlike the eliminating
+    pass this never needs explicit roots — with no loss/fetch context
+    it roots at the terminal vars, so only INTERIOR dead chains (ops
+    whose results are consumed by nothing, not even transitively by a
+    terminal var) are reported."""
+
+    def __init__(self, fetch_vars=None):
+        self._fetch = list(fetch_vars or [])
+
+    def analyze(self, program):
+        roots = list(self._fetch)
+        if (not roots and program._loss_var is None
+                and not getattr(program, "_grad_of", {})):
+            roots = _terminal_vars(program)
+        kept, _ = live_op_slice(program, roots)
+        kept_ids = {id(op) for op in kept}
+        findings = []
+        for op in program.global_block().ops:
+            if id(op) not in kept_ids:
+                names = [v.name for v in op.out_vars]
+                findings.append(Finding(
+                    "PTA010",
+                    f"op {op.type!r} (-> {names}) is dead: its "
+                    "outputs reach no loss/fetch root — remove it or "
+                    "run dead_op_elimination before export",
+                    analyzer="program"))
+        return findings
+
+
+@register_pass("unfetched_output_analysis")
+class UnfetchedOutputAnalysisPass(AnalysisPass):
+    """PTA011: terminal vars (consumed by no op) that are also not
+    declared fetch targets / the loss — results the program computes
+    but nobody will ever read through Executor.run."""
+
+    def __init__(self, fetch_vars=None):
+        self._fetch = {id(v) for v in (fetch_vars or [])}
+
+    def analyze(self, program):
+        known = set(self._fetch)
+        if program._loss_var is not None:
+            known.add(id(program._loss_var))
+        for _, (loss_v, _t) in getattr(program, "_grad_of",
+                                       {}).items():
+            known.add(id(loss_v))
+        findings = []
+        for v in _terminal_vars(program):
+            if id(v) not in known:
+                findings.append(Finding(
+                    "PTA011",
+                    f"variable {v.name!r} (shape {list(v.shape)}) is "
+                    "produced but neither consumed nor fetched — "
+                    "fetch it or drop its producing op",
+                    analyzer="program"))
+        return findings
+
+
+@register_pass("op_coverage_analysis")
+class OpCoverageAnalysisPass(AnalysisPass):
+    """PTA012 (info): op-type histogram over every block — the
+    at-a-glance answer to "what does this program actually run", and
+    the hook for spotting ops a backend/pass pipeline doesn't cover.
+    The counts are also stashed on `self.coverage`."""
+
+    coverage = None
+
+    def analyze(self, program):
+        counts = Counter()
+        for blk in program.blocks:
+            counts.update(op.type for op in blk.ops)
+        self.coverage = dict(counts)
+        if not counts:
+            return []
+        total = sum(counts.values())
+        top = ", ".join(f"{t}×{n}" for t, n in counts.most_common(8))
+        return [Finding(
+            "PTA012",
+            f"{total} op(s) across {len(program.blocks)} block(s), "
+            f"{len(counts)} distinct type(s): {top}",
+            severity=Severity.INFO, analyzer="program")]
+
+
+def analyze_program(program, fetch_vars=None, report=None):
+    """Run the full read-only pass suite over a Program."""
+    from .diagnostics import Report
+
+    report = report if report is not None else Report()
+    for p in (DeadVarAnalysisPass(fetch_vars),
+              UnfetchedOutputAnalysisPass(fetch_vars),
+              OpCoverageAnalysisPass()):
+        p.apply(program)
+        report.extend(p.last_findings)
+    return report

@@ -52,6 +52,16 @@ class ReduceOp:
     AVG = 4
 
 
+# jax primitive names that lower to XLA collectives — the single
+# source of truth `analysis.collectives` walks traced programs with
+# (EQuARX-style consistency checking needs exact op agreement, so the
+# registry lives next to the ops that emit them)
+COMM_PRIMITIVE_NAMES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+
 def _payload_bytes(x):
     """Byte size of a collective's payload from STATIC shape/dtype info
     (works on tracers — inside shard_map the span measures trace time

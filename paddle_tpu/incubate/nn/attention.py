@@ -11,7 +11,6 @@ moderate sequence lengths). Selection is automatic by platform.
 """
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 import jax

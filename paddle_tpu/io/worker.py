@@ -19,7 +19,6 @@ from __future__ import annotations
 import ctypes
 import os
 import pickle
-import queue
 import sys as _sys
 import threading
 

@@ -84,17 +84,34 @@ _digest_cache = {}  # id(arr) -> (weakref, digest); bounded
 _DIGEST_CACHE_MAX = 64
 
 
-def _freeze_static(v):
-    """Hashable cache-key form of a static (non-Tensor) argument.
+def _digest_cache_evict_one():
+    """Make room for one entry: drop a dead-weakref entry if any,
+    else the oldest (first-inserted — dicts preserve insertion
+    order). The old overflow behavior cleared the WHOLE memo, which
+    re-hashed every live static table on the next call."""
+    dead = next((k for k, (wr, _) in _digest_cache.items()
+                 if wr() is None), None)
+    _digest_cache.pop(dead if dead is not None
+                      else next(iter(_digest_cache)))
+    _monitor.stat_add("jit/digest_cache/evictions", 1)
+
+
+def _freeze_static_ex(v, memoize=True):
+    """(cache key, kind) for a static (non-Tensor) argument; kind in
+    {"hashable", "ndarray", "pickled", "id"} — the classification
+    `analysis` reports recompile hazards from (PTA006), off the SAME
+    code path jit keys its program cache with.
+
     Arrays hash by CONTENT digest — repr() truncates big arrays and
     would silently collide distinct values into one compiled program.
     Digests memoize per array object (weakly) so a large static table
     is hashed once, not on every call; in-place mutation of a static
     arg after first use is not supported (jax's own static-arg
-    contract)."""
+    contract). `memoize=False` (analysis probes) skips the memo so
+    probing never evicts a hot entry."""
     try:
         hash(v)
-        return v
+        return v, "hashable"
     except TypeError:
         pass
     if isinstance(v, np.ndarray):
@@ -103,25 +120,30 @@ def _freeze_static(v):
 
         ent = _digest_cache.get(id(v))
         if ent is not None and ent[0]() is v:
-            return ent[1]
+            return ent[1], "ndarray"
         key = ("ndarray", v.shape, str(v.dtype),
                hashlib.sha256(np.ascontiguousarray(v).tobytes())
                .digest())
-        try:
-            if len(_digest_cache) >= _DIGEST_CACHE_MAX:
-                _digest_cache.clear()
-            _digest_cache[id(v)] = (weakref.ref(v), key)
-        except TypeError:
-            pass
-        return key
+        if memoize:
+            try:
+                if len(_digest_cache) >= _DIGEST_CACHE_MAX:
+                    _digest_cache_evict_one()
+                _digest_cache[id(v)] = (weakref.ref(v), key)
+            except TypeError:
+                pass
+        return key, "ndarray"
     try:
         import hashlib
         import pickle
 
         return ("pickled",
-                hashlib.sha256(pickle.dumps(v)).digest())
+                hashlib.sha256(pickle.dumps(v)).digest()), "pickled"
     except Exception:
-        return ("id", id(v))
+        return ("id", id(v)), "id"
+
+
+def _freeze_static(v):
+    return _freeze_static_ex(v)[0]
 
 
 from .dy2static import source_calls_grad as _source_calls_grad  # noqa: E402
@@ -225,6 +247,14 @@ class StaticFunction:
         entry = self._compiled.get(key)
         compile_ev = None
         if entry is None:
+            # opt-in static analysis at build time (PADDLE_ANALYSIS=1,
+            # gated inside the hook): preflight + jaxpr lint of the
+            # about-to-compile program; purely observational — never
+            # alters the trace below, never raises
+            from ..analysis import trace_build_hook
+
+            trace_build_hook(target, args=args, kwargs=kwargs,
+                             where=f"to_static:{fname}")
             # telemetry (reference: program cache stats in
             # program_translator): a miss triggers a fresh trace + XLA
             # compile — spanned and timed below. The real work happens
@@ -643,6 +673,18 @@ class TrainStepCompiler:
         trainable, frozen, bufs = self._params_and_buffers()
         self._prepare_call(trainable, frozen, bufs)
         if self._compiled is None:
+            # opt-in analysis of the model forward about to be fused
+            # into the step (PADDLE_ANALYSIS=1, gated inside the
+            # hook) — observational only. Batch elements are placed
+            # on device as traced inputs by _place_batch — mirror
+            # that, not the to_static static-arg contract
+            from ..analysis import trace_build_hook
+
+            fwd_args = (batch[:-1] if self._loss_fn is not None
+                        and len(batch) > 1 else batch)
+            trace_build_hook(self._model, args=fwd_args,
+                             where="train_step",
+                             arrays_as_tensors=True)
             # first call traces + XLA-compiles the whole fused step:
             # span it and record the wall time under jit/train_step/...
             # (the per-StaticFunction counters' TrainStepCompiler

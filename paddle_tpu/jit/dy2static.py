@@ -61,7 +61,8 @@ __all__ = ["convert_ifelse", "convert_while", "convert_print",
            "convert_logical_not", "convert_shape", "convert_call",
            "convert_list_append", "check_range_step", "TensorArray",
            "ast_transform", "set_max_loop_iterations",
-           "max_loop_iterations", "last_loop_truncated"]
+           "max_loop_iterations", "last_loop_truncated",
+           "unsupported_constructs"]
 
 # bounded-loop mode: when set, converted `while` lowers to a
 # fixed-trip `lax.scan` with a done-mask instead of `lax.while_loop`.
@@ -1028,6 +1029,65 @@ def _rewrite_returns(stmts, rf, rv):
                     body=rest, orelse=[]))
             return out
         out.append(s)
+    return out
+
+
+def unsupported_constructs(fdef):
+    """AST-level list of (reason, lineno) for constructs this
+    transformer refuses — the contract `analysis.preflight` lints
+    against, kept HERE so the refusal conditions and the lint stay in
+    one file. Mirrors the _Unsupported raises above:
+
+      * for/else, while/else (visit_For / visit_While)
+      * break/continue with a try/with between it and its loop
+        (_rewrite_break_continue)
+      * return under control flow with a try/with ancestor — either
+        order: _rewrite_returns raises on a may-return try/with, and a
+        return that reaches visit_If inside a top-level try escapes
+        the return pre-pass entirely (_has_nested_return never
+        descends into Try)
+
+    Any hit means ast_transform returns None and the function degrades
+    to trace-only conversion: data-dependent control flow inside it
+    will crash at trace time instead of lowering to lax.cond/while.
+    Does not descend into nested function defs (their conversion is
+    their own, at their convert_call site)."""
+    out = []
+
+    def scan(node, ctx):
+        for child in ast.iter_child_nodes(node):
+            t = type(child)
+            if t in (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.Lambda):
+                continue
+            if t is ast.For and child.orelse:
+                out.append(("for/else is not convertible",
+                            child.lineno))
+            if t is ast.While and child.orelse:
+                out.append(("while/else is not convertible",
+                            child.lineno))
+            if t in (ast.Break, ast.Continue):
+                for kind in reversed(ctx):
+                    if kind == "loop":
+                        break
+                    if kind == "trywith":
+                        out.append(
+                            (f"{'break' if t is ast.Break else 'continue'}"
+                             " inside try/with in a converted loop",
+                             child.lineno))
+                        break
+            if t is ast.Return:
+                if "trywith" in ctx and ("loop" in ctx or "if" in ctx):
+                    out.append(
+                        ("return under control flow with a try/with "
+                         "ancestor", child.lineno))
+            tag = ("loop" if t in (ast.For, ast.While)
+                   else "trywith" if t in (ast.Try, ast.With,
+                                           ast.AsyncWith)
+                   else "if" if t is ast.If else None)
+            scan(child, ctx + [tag] if tag else ctx)
+
+    scan(fdef, [])
     return out
 
 

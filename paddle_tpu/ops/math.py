@@ -158,9 +158,10 @@ def _k_scale(x, scale, bias, bias_after_scale):
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    out = apply_op("scale", _k_scale, x,
-                   scale=float(_val(scale)) if not isinstance(scale, Tensor) else float(scale.item()),
-                   bias=float(bias), bias_after_scale=bool(bias_after_scale))
+    sv = (float(scale.item()) if isinstance(scale, Tensor)
+          else float(_val(scale)))
+    out = apply_op("scale", _k_scale, x, scale=sv, bias=float(bias),
+                   bias_after_scale=bool(bias_after_scale))
     if act:
         from . import activation
 

@@ -9,10 +9,8 @@ of the reference's fused_adam / multi_tensor paths).
 """
 from __future__ import annotations
 
-import collections
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from ..core.engine import no_grad

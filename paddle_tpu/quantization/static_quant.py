@@ -32,7 +32,6 @@ conv*: OIHW -> axis 0).
 from __future__ import annotations
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor

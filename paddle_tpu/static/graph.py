@@ -19,7 +19,6 @@ custom lowering for.
 from __future__ import annotations
 
 import functools
-import threading
 
 import numpy as np
 import jax

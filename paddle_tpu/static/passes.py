@@ -6,15 +6,18 @@ perf-critical fusions (VERDICT r1 notes fusion is subsumed), so the
 role of passes here is GRAPH REWRITING the compiler can't do for you:
 dead-op elimination before export, op substitution (quant rewrites,
 custom fusions), and inspection — operating on the OpRecord list the
-Executor replays.
+Executor replays. Read-only inspection is the `AnalysisPass` family
+(paddle_tpu/analysis/program.py registers the concrete analyzers);
+the liveness slice both worlds need lives here as `live_op_slice`.
 """
 from __future__ import annotations
 
 from ..core.tensor import Tensor
-from .graph import OpRecord, Program, Variable
+from .graph import Program
 
-__all__ = ["Pass", "PassRegistry", "register_pass", "apply_pass",
-           "DeadOpEliminationPass", "OpSubstitutionPass"]
+__all__ = ["Pass", "AnalysisPass", "PassRegistry", "register_pass",
+           "apply_pass", "live_op_slice", "DeadOpEliminationPass",
+           "OpSubstitutionPass"]
 
 
 class Pass:
@@ -25,6 +28,24 @@ class Pass:
 
     def apply(self, program: Program) -> Program:
         raise NotImplementedError
+
+
+class AnalysisPass(Pass):
+    """Read-only pass: `analyze(program)` returns a list of
+    `analysis.Finding`s and MUST NOT mutate the Program. `apply` runs
+    the analysis (stashing the findings on `last_findings`) and
+    returns the program unchanged, so analysis passes compose in the
+    same registry/apply_pass pipeline as rewrites — but `apply_pass`
+    skips the replay-cache version bump for them (nothing changed)."""
+
+    last_findings = ()
+
+    def analyze(self, program: Program):
+        raise NotImplementedError
+
+    def apply(self, program: Program) -> Program:
+        self.last_findings = list(self.analyze(program))
+        return program
 
 
 class PassRegistry:
@@ -64,9 +85,38 @@ def apply_pass(program, name_or_pass):
          else registry.get(name_or_pass))
     out = p.apply(program)
     # invalidate Executor's compiled-replay cache (keys include the
-    # program version)
-    program._version = getattr(program, "_version", 0) + 1
+    # program version) — except for read-only analysis passes, which
+    # by contract change nothing and must not force a recompile
+    if not isinstance(p, AnalysisPass):
+        program._version = getattr(program, "_version", 0) + 1
     return out
+
+
+def live_op_slice(program, extra_roots=()):
+    """Backward liveness slice of the GLOBAL block: (kept_ops,
+    live_ids). Roots are `extra_roots` (fetch targets) plus the train
+    loss and grad-spec losses. Transitively dead chains (a -> dead b
+    -> nothing) fall out in one application. Only the global block is
+    sliced: control-flow sub-block ops are reached through their
+    parent cond/while op's replay closures, not through out_vars, so
+    slicing them would break replay. Shared by DeadOpEliminationPass
+    (which drops the dead ops) and the read-only analysis passes
+    (which report them)."""
+    live = {id(v) for v in extra_roots}
+    if program._loss_var is not None:
+        live.add(id(program._loss_var))
+    for _, (loss_v, _t) in getattr(program, "_grad_of", {}).items():
+        live.add(id(loss_v))
+    blk = program.global_block()
+    kept = []
+    for op in reversed(blk.ops):
+        if any(id(v) in live for v in op.out_vars):
+            kept.append(op)
+            for leaf in op.in_leaves:
+                if isinstance(leaf, Tensor):
+                    live.add(id(leaf))
+    kept.reverse()
+    return kept, live
 
 
 @register_pass("dead_op_elimination")
@@ -76,35 +126,18 @@ class DeadOpEliminationPass(Pass):
     (ir/graph_to_program_pass + Program._prune)."""
 
     def __init__(self, keep_vars=None):
-        self._keep = {id(v) for v in (keep_vars or [])}
+        self._keep = list(keep_vars or [])
 
     def apply(self, program):
-        # roots: explicit keeps, the train loss, grad-spec losses
-        live = set(self._keep)
-        if program._loss_var is not None:
-            live.add(id(program._loss_var))
-        for _, (loss_v, _t) in getattr(program, "_grad_of", {}).items():
-            live.add(id(loss_v))
-        if not live:
+        roots = list(self._keep)
+        if (not roots and program._loss_var is None
+                and not getattr(program, "_grad_of", {})):
             raise ValueError(
                 "dead_op_elimination has no roots — pass keep_vars "
                 "(your fetch targets) or record a loss first; with an "
                 "empty live set the pass would delete the whole graph")
-        # Backward slice in reverse op order — transitively dead chains
-        # (a -> dead b -> nothing) die in ONE application. Only the
-        # global block is sliced: control-flow sub-block ops are
-        # reached through their parent cond/while op's replay closures,
-        # not through out_vars, so slicing them would break replay.
-        blk = program.global_block()
-        kept = []
-        for op in reversed(blk.ops):
-            if any(id(v) in live for v in op.out_vars):
-                kept.append(op)
-                for leaf in op.in_leaves:
-                    if isinstance(leaf, Tensor):
-                        live.add(id(leaf))
-        kept.reverse()
-        blk.ops = kept
+        kept, _ = live_op_slice(program, roots)
+        program.global_block().ops = kept
         return program
 
 

@@ -18,7 +18,6 @@ import ctypes
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 from ..core.engine import apply_op
 

@@ -1,0 +1,558 @@
+"""paddle_tpu.analysis: one seeded program per analyzer family
+(dtype promotion, recompile hazard, const capture, dead output,
+collective mismatch, dy2static-unsupported), CLI exit-status contract,
+Program-IR analysis passes, and the PADDLE_ANALYSIS trace-time hook."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.core import monitor as cm
+from paddle_tpu.jit import InputSpec
+
+THIS_FILE = __file__
+
+
+def _codes(report):
+    return {f.code for f in report.findings}
+
+
+def _only(report, code):
+    hits = [f for f in report.findings if f.code == code]
+    assert hits, f"expected {code}, got {report.findings}"
+    return hits[0]
+
+
+def _assert_anchored_here(finding):
+    assert finding.file == THIS_FILE, finding
+    assert isinstance(finding.line, int) and finding.line > 0, finding
+    assert f"{THIS_FILE}:{finding.line}" in finding.format()
+
+
+# ---------------------------------------------------------------------------
+# jaxpr analyzer families
+# ---------------------------------------------------------------------------
+
+def test_dtype_float64_spec_flagged():
+    def f(x):
+        return x + 1.0
+
+    rep = analysis.check(f, input_spec=[InputSpec([4], "float64")],
+                         record=False)
+    find = _only(rep, "PTA001")
+    assert find.severity == "error"
+    _assert_anchored_here(find)
+
+
+def test_dtype_implicit_promotion_flagged():
+    full = paddle.to_tensor(np.ones(4, np.float32))
+
+    def f(x):
+        return x + full  # bf16 + f32 -> silent upcast
+
+    rep = analysis.check(f, input_spec=[InputSpec([4], "bfloat16")],
+                         record=False)
+    find = _only(rep, "PTA002")
+    _assert_anchored_here(find)
+
+
+def test_recompile_hazard_static_args():
+    def f(x, cfg=None, scale=1.0):
+        return x * scale
+
+    rep = analysis.check(
+        f, input_spec=[InputSpec([4], "float32")],
+        static_args={"cfg": {"lr": 0.1}, "scale": 0.5}, record=False)
+    hits = [fi for fi in rep.findings if fi.code == "PTA006"]
+    assert len(hits) == 2  # unhashable dict + python float
+    msgs = " ".join(fi.message for fi in hits)
+    assert "unhashable" in msgs and "float" in msgs
+    _assert_anchored_here(hits[0])
+
+
+def test_recompile_hazard_id_fallback_is_error():
+    class Unpicklable:
+        __hash__ = None
+
+        def __reduce__(self):
+            raise TypeError("no pickling")
+
+    rep = analysis.Report()
+    analysis.jaxpr.analyze_static_args(
+        [Unpicklable()], rep, anchor=(THIS_FILE, 1))
+    find = _only(rep, "PTA006")
+    assert find.severity == "error"
+    assert "id()" in find.message
+
+
+def test_const_capture_bloat():
+    table = np.arange(4096, dtype=np.float32)
+
+    def f(x):
+        return x + paddle.to_tensor(table)
+
+    rep = analysis.check(f, input_spec=[InputSpec([4096], "float32")],
+                         const_bytes_threshold=1024, record=False)
+    find = _only(rep, "PTA003")
+    assert "16384 bytes" in find.message
+    _assert_anchored_here(find)
+    # above the default 1 MiB threshold nothing fires
+    rep2 = analysis.check(f, input_spec=[InputSpec([4096], "float32")],
+                          record=False)
+    assert "PTA003" not in _codes(rep2)
+
+
+def test_dead_computation():
+    def f(x):
+        wasted = paddle.exp(x) * 3.0  # noqa: F841 — dead on purpose
+        return x + 1.0
+
+    rep = analysis.check(f, input_spec=[InputSpec([4], "float32")],
+                         record=False)
+    find = _only(rep, "PTA004")
+    assert "exp" in find.message
+    _assert_anchored_here(find)
+
+
+def test_tracer_leak_detected_and_preexisting_excluded():
+    holder = []
+
+    def leaky(x):
+        y = x * 2.0
+        holder.append(y)
+        return y + 1.0
+
+    rep = analysis.check(leaky, input_spec=[InputSpec([4], "float32")],
+                         record=False)
+    find = _only(rep, "PTA005")
+    assert find.severity == "error"
+    _assert_anchored_here(find)
+
+    # the stale tracer is PRE-existing for the next check: a clean
+    # function sharing the closure must not inherit the finding
+    def clean(x, holder=holder):
+        return x * 2.0
+
+    rep2 = analysis.check(clean, input_spec=[InputSpec([4], "float32")],
+                          record=False)
+    assert "PTA005" not in _codes(rep2)
+    holder.clear()
+
+
+def test_clean_function_is_clean():
+    net = paddle.nn.Linear(4, 2)
+
+    def f(x):
+        return net(x)
+
+    rep = analysis.check(f, input_spec=[InputSpec([None, 4], "float32")],
+                         record=False)
+    assert rep.findings == [] and rep.ok and rep.exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# collective consistency
+# ---------------------------------------------------------------------------
+
+def _two_rank_digests():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.analysis import collectives as C
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+
+    def rank_a(v):
+        v = jax.lax.psum(v, "x")
+        return jax.lax.all_gather(v, "x")
+
+    def rank_b(v):  # DIFFERENT collective order — would deadlock
+        g = jax.lax.all_gather(v, "x")
+        return jax.lax.psum(g, "x")
+
+    def ops_of(fn):
+        closed = jax.make_jaxpr(shard_map(
+            fn, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+            check_rep=False))(jnp.ones((8,)))
+        return C.collect_comm_ops(closed)
+
+    return ops_of(rank_a), ops_of(rank_b)
+
+
+def test_collective_mismatch_reported_per_rank():
+    from paddle_tpu.analysis import collectives as C
+
+    ops_a, ops_b = _two_rank_digests()
+    assert [o.name for o in ops_a] == ["psum", "all_gather"]
+    gathered = np.stack([C.comm_digest(ops_a), C.comm_digest(ops_b)])
+    # rank 1's view: it diverges and sees its own local op at the fork
+    rep = C.compare_comm_digests(gathered, rank=1, local_ops=ops_b)
+    find = _only(rep, "PTA020")
+    assert find.severity == "error"
+    assert "fork at op index 0" in find.message
+    assert "all_gather" in find.message  # rank 1's local op there
+    assert find.file and find.line  # anchored at the comm op eqn
+    assert f"{find.file}:{find.line}" in find.format()
+    # rank 0's view: names rank 1 as the divergent peer
+    rep0 = C.compare_comm_digests(gathered, rank=0, local_ops=ops_a)
+    assert "rank 1" in _only(rep0, "PTA020").message
+
+
+def test_collective_consistent_ranks_clean():
+    from paddle_tpu.analysis import collectives as C
+
+    ops_a, _ = _two_rank_digests()
+    gathered = np.stack([C.comm_digest(ops_a)] * 4)
+    rep = C.compare_comm_digests(gathered, rank=2, local_ops=ops_a)
+    assert rep.findings == []
+
+
+def test_collective_single_process_info():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.analysis import collectives as C
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    closed = jax.make_jaxpr(shard_map(
+        lambda v: jax.lax.psum(v, "x"), mesh=mesh, in_specs=P("x"),
+        out_specs=P(None), check_rep=False))(jnp.ones((8,)))
+    rep = C.check_collectives(closed)
+    find = _only(rep, "PTA021")
+    assert find.severity == "info" and "psum" in find.message
+
+
+# ---------------------------------------------------------------------------
+# dy2static preflight
+# ---------------------------------------------------------------------------
+
+def test_preflight_unsupported_construct():
+    def bad(x):
+        for i in range(3):
+            x = x + i
+        else:
+            x = x - 1
+        return x
+
+    rep = analysis.preflight(bad)
+    find = _only(rep, "PTA033")
+    assert find.severity == "error"
+    assert "for/else" in find.message
+    _assert_anchored_here(find)
+
+
+def test_preflight_inplace_mutation_in_while():
+    def bad(x, items):
+        while x.sum() > 0:
+            items.extend([x])
+            x = x - 1
+        return x
+
+    rep = analysis.preflight(bad)
+    find = _only(rep, "PTA031")
+    assert find.severity == "error"
+    _assert_anchored_here(find)
+
+
+def test_preflight_truncation_and_host_sync():
+    from paddle_tpu.jit import set_max_loop_iterations
+
+    def loopy(x):
+        while x.sum() > 0:
+            x = x - 1
+        return x.numpy()
+
+    prev = set_max_loop_iterations(8)
+    try:
+        rep = analysis.preflight(loopy)
+    finally:
+        set_max_loop_iterations(prev)
+    assert {"PTA032", "PTA034"} <= _codes(rep)
+    rep2 = analysis.preflight(loopy)  # no bound -> no truncation risk
+    assert "PTA032" not in _codes(rep2)
+
+
+def test_preflight_return_in_try_under_control_flow():
+    def bad(x):
+        if x.sum() > 0:
+            try:
+                return x * 2
+            finally:
+                pass
+        return x
+
+    rep = analysis.preflight(bad)
+    assert "PTA033" in _codes(rep)
+
+
+# ---------------------------------------------------------------------------
+# Program-IR analysis passes
+# ---------------------------------------------------------------------------
+
+def test_program_analysis_passes():
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            live = paddle.nn.functional.relu(x)
+            dead = paddle.exp(x) * 3.0  # noqa: F841 — dead chain
+            out = live * 2.0
+        rep = analysis.analyze_program(main, fetch_vars=[out])
+        codes = _codes(rep)
+        assert {"PTA010", "PTA011", "PTA012"} <= codes
+        # both ops of the dead chain are reported (transitive slice)
+        dead_msgs = [f.message for f in rep.findings
+                     if f.code == "PTA010"]
+        assert len(dead_msgs) == 2
+        # the read-only suite didn't touch the program
+        assert len(main.global_block().ops) == 4
+    finally:
+        paddle.disable_static()
+
+
+def test_analysis_pass_does_not_bump_version():
+    import paddle_tpu.static as static
+    from paddle_tpu.analysis import DeadVarAnalysisPass
+    from paddle_tpu.static.passes import (DeadOpEliminationPass,
+                                          apply_pass)
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [2, 2], "float32")
+            y = paddle.nn.functional.relu(x)
+        v0 = getattr(main, "_version", 0)
+        apply_pass(main, DeadVarAnalysisPass(fetch_vars=[y]))
+        assert getattr(main, "_version", 0) == v0  # read-only: no bump
+        apply_pass(main, DeadOpEliminationPass(keep_vars=[y]))
+        assert getattr(main, "_version", 0) == v0 + 1  # rewrite: bump
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+BAD_MODULE = '''
+import paddle_tpu as paddle
+
+
+@paddle.jit.to_static
+def trouble(x):
+    while x.sum() > 0:
+        x = x - 1
+    else:
+        x = x + 1
+    return x
+'''
+
+CLEAN_MODULE = '''
+def helper(a):
+    return a + 1
+
+
+class Net:
+    def forward(self, x):
+        return x * 2
+'''
+
+
+def test_cli_exit_nonzero_on_error_finding(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+
+    bad = tmp_path / "bad_mod.py"
+    bad.write_text(BAD_MODULE)
+    rc = main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "PTA033" in out and f"{bad}:7" in out
+
+
+def test_cli_exit_zero_on_clean_module(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+
+    clean = tmp_path / "clean_mod.py"
+    clean.write_text(CLEAN_MODULE)
+    rc = main([str(clean)])
+    assert rc == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_cli_noqa_suppression(tmp_path, capsys):
+    from paddle_tpu.analysis.cli import main
+
+    # suppression must sit on the flagged line (the while/else
+    # construct anchors at the `while`)
+    src = BAD_MODULE.replace(
+        "    while x.sum() > 0:",
+        "    while x.sum() > 0:  # noqa: PTA033")
+    f = tmp_path / "suppressed.py"
+    f.write_text(src)
+    rc = main([str(f)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_directory_and_json(tmp_path, capsys):
+    import json
+
+    from paddle_tpu.analysis.cli import main
+
+    (tmp_path / "a.py").write_text(CLEAN_MODULE)
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "b.py").write_text(BAD_MODULE)
+    rc = main([str(tmp_path), "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["files"] == 2
+    assert any(f["code"] == "PTA033" for f in payload["findings"])
+
+
+# ---------------------------------------------------------------------------
+# trace-time hook (PADDLE_ANALYSIS=1) + counters
+# ---------------------------------------------------------------------------
+
+def test_env_hook_surfaces_findings_without_changing_results(
+        monkeypatch, capsys):
+    from paddle_tpu.jit import to_static
+
+    def f(x):
+        wasted = paddle.exp(x)  # noqa: F841 — seeded dead op
+        return x * 2.0
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    baseline = to_static(f)(x).numpy()
+
+    monkeypatch.setenv("PADDLE_ANALYSIS", "1")
+    before = cm.stat_get("analysis/PTA004/findings")
+    out = to_static(f)(x)  # fresh StaticFunction -> cache miss -> hook
+    np.testing.assert_allclose(out.numpy(), baseline)
+    assert cm.stat_get("analysis/PTA004/findings") == before + 1
+    assert "PTA004" in capsys.readouterr().err
+
+    # off by default: no counters move
+    monkeypatch.delenv("PADDLE_ANALYSIS")
+    mid = cm.stat_get("analysis/PTA004/findings")
+    out2 = to_static(f)(x)
+    np.testing.assert_allclose(out2.numpy(), baseline)
+    assert cm.stat_get("analysis/PTA004/findings") == mid
+
+
+def test_check_records_monitor_counters():
+    def f(x):
+        wasted = paddle.exp(x)  # noqa: F841
+        return x + 1.0
+
+    before_checks = cm.stat_get("analysis/checks")
+    before = cm.stat_get("analysis/PTA004/findings")
+    rep = analysis.check(f, input_spec=[InputSpec([4], "float32")])
+    assert "PTA004" in _codes(rep)
+    assert cm.stat_get("analysis/checks") == before_checks + 1
+    assert cm.stat_get("analysis/PTA004/findings") == before + 1
+
+
+def test_report_severity_and_diagnostics_table():
+    rep = analysis.Report()
+    rep.add("PTA004", "m1")
+    assert rep.exit_code == 0  # warnings don't fail the build
+    rep.add("PTA005", "m2")
+    assert rep.exit_code == 1
+    # every code the analyzers can emit is documented
+    for code in ("PTA001", "PTA002", "PTA003", "PTA004", "PTA005",
+                 "PTA006", "PTA010", "PTA011", "PTA012", "PTA020",
+                 "PTA021", "PTA030", "PTA031", "PTA032", "PTA033",
+                 "PTA034"):
+        sev, title, fix = analysis.DIAGNOSTICS[code]
+        assert sev in ("error", "warning", "info") and title and fix
+
+
+def test_check_honors_noqa_on_anchor_line(tmp_path):
+    """`# noqa: PTA0xx` on the anchored line suppresses the finding
+    in the programmatic path too (not just the CLI), so accepted
+    findings don't re-print on every build or dirty the counters."""
+    import importlib.util
+
+    mod = tmp_path / "noqa_mod.py"
+    mod.write_text(
+        "import paddle_tpu as paddle\n"
+        "def f(x):\n"
+        "    wasted = paddle.exp(x)  # noqa: PTA004\n"
+        "    return x * 2.0\n")
+    spec = importlib.util.spec_from_file_location("noqa_mod", mod)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    rep = analysis.check(m.f, input_spec=[InputSpec([4], "float32")],
+                         record=False)
+    assert "PTA004" not in _codes(rep)
+
+
+def test_collectives_hook_mode_never_gathers(monkeypatch):
+    """exchange=False (the PADDLE_ANALYSIS hook mode) logs a digest
+    fingerprint instead of entering an all_gather that would hang
+    when peer ranks don't participate."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.analysis import collectives as C
+    from paddle_tpu.distributed import collective as coll
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    closed = jax.make_jaxpr(shard_map(
+        lambda v: jax.lax.psum(v, "x"), mesh=mesh, in_specs=P("x"),
+        out_specs=P(None), check_rep=False))(jnp.ones((8,)))
+    monkeypatch.setattr(coll, "_nprocs", lambda: 2)
+    monkeypatch.setattr(coll, "_proc_index", lambda: 0)
+
+    def boom(*a, **k):
+        raise AssertionError("hook mode must not call all_gather")
+
+    monkeypatch.setattr(coll, "all_gather", boom)
+    rep = C.check_collectives(closed, exchange=False)
+    find = _only(rep, "PTA021")
+    assert "digest" in find.message and "rank 0" in find.message
+
+
+def test_collectives_zero_op_rank_still_joins_exchange(monkeypatch):
+    """A rank that traced NO comm ops must still join the digest
+    all_gather in exchange mode (and then report its own divergence)
+    — skipping would hang the peers inside the checker itself."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.analysis import collectives as C
+    from paddle_tpu.distributed import collective as coll
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    peer = jax.make_jaxpr(shard_map(
+        lambda v: jax.lax.psum(v, "x"), mesh=mesh, in_specs=P("x"),
+        out_specs=P(None), check_rep=False))(jnp.ones((8,)))
+    peer_digest = C.comm_digest(C.collect_comm_ops(peer))
+    monkeypatch.setattr(coll, "_nprocs", lambda: 2)
+    monkeypatch.setattr(coll, "_proc_index", lambda: 1)
+    calls = []
+
+    def fake_all_gather(lst, tensor, group=None):
+        calls.append(np.asarray(tensor._value, np.uint32))
+        lst.extend([paddle.to_tensor(peer_digest), tensor])
+        return lst
+
+    monkeypatch.setattr(coll, "all_gather", fake_all_gather)
+    local = jax.make_jaxpr(lambda v: v + 1.0)(jnp.ones((4,)))
+    rep = C.check_collectives(local, exchange=True)
+    assert calls, "zero-op rank must still join the digest gather"
+    assert int(calls[0][0]) == 0  # its digest says: zero comm ops
+    find = _only(rep, "PTA020")
+    assert "this rank" in find.message

@@ -1,0 +1,62 @@
+"""Tier-1 gate: the package must stay clean under its own linters.
+
+Two halves: `ruff check` (only when ruff is installed — the container
+may not ship it) against ruff.toml, and `python -m paddle_tpu.analysis`
+over the whole package + the e2e test — the ISSUE-2 self-audit,
+re-run on every tier-1 pass so regressions in our own code fail CI."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+
+def test_analysis_cli_clean_over_package(capsys):
+    from paddle_tpu.analysis.cli import main
+
+    rc = main([PKG, os.path.join(REPO, "tests", "test_e2e_mnist.py")])
+    out = capsys.readouterr().out
+    assert rc == 0, f"self-audit found error-severity findings:\n{out}"
+
+
+def test_analysis_jaxpr_selfaudit_vision_models():
+    """Deep (traced) half of the self-audit: representative vision
+    models must produce no error-severity findings when abstractly
+    traced — dtype leaks, tracer leaks, and id-keyed static args in
+    our own models fail the build."""
+    from paddle_tpu import analysis
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.vision.models import LeNet, resnet18
+
+    for net, spec in (
+            (LeNet(), InputSpec([None, 1, 28, 28], "float32")),
+            (resnet18(), InputSpec([None, 3, 32, 32], "float32"))):
+        rep = analysis.check(net, input_spec=[spec], record=False)
+        assert rep.ok, (type(net).__name__,
+                        [f.format() for f in rep.errors])
+
+
+def test_ruff_clean_if_installed():
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this environment")
+    proc = subprocess.run(
+        [ruff, "check", PKG, os.path.join(REPO, "tests"),
+         os.path.join(REPO, "bench.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_module_entrypoint():
+    """`python -m paddle_tpu.analysis` is wired (argparse usage on
+    no args exits 2, not an import crash)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--help"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "PTA0xx" in proc.stdout

@@ -101,3 +101,41 @@ def test_jit_save_load(tmp_path):
     x = paddle.rand([3, 2])
     np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
                                rtol=1e-6)
+
+
+def test_digest_cache_evicts_single_entry_not_whole_memo():
+    """Overflow evicts ONE entry (dead weakref preferred, else the
+    oldest) and counts it — the old behavior clear()'d the whole memo,
+    re-hashing every live static table on the next call."""
+    import paddle_tpu.jit as jit
+    from paddle_tpu.core import monitor as cm
+
+    jit._digest_cache.clear()
+    keep = [np.full((4,), i, np.float32)
+            for i in range(jit._DIGEST_CACHE_MAX + 3)]  # refs stay live
+    before = cm.stat_get("jit/digest_cache/evictions")
+    for a in keep:
+        jit._freeze_static(a)
+    # never wholesale-cleared: the memo sits at capacity, 3 evictions
+    assert len(jit._digest_cache) == jit._DIGEST_CACHE_MAX
+    assert cm.stat_get("jit/digest_cache/evictions") == before + 3
+    # most-recent entries survived and still memo-hit
+    ent = jit._digest_cache.get(id(keep[-1]))
+    assert ent is not None and ent[0]() is keep[-1]
+    key_again = jit._freeze_static(keep[-1])
+    assert key_again is ent[1]
+    # dead-weakref entries are evicted before live ones
+    jit._digest_cache.clear()
+    a = np.ones((2,), np.float32)
+    b = np.ones((3,), np.float32)
+    tmp = np.ones((4,), np.float32)
+    jit._freeze_static(a)
+    jit._freeze_static(tmp)
+    jit._freeze_static(b)
+    tmp_id = id(tmp)
+    del tmp  # its cache entry's weakref goes dead
+    jit._digest_cache_evict_one()
+    assert tmp_id not in jit._digest_cache
+    assert id(a) in jit._digest_cache  # older LIVE entry survived
+    assert id(b) in jit._digest_cache
+    jit._digest_cache.clear()

@@ -1,0 +1,164 @@
+"""static/passes.py pass-framework tests: registry error contract,
+apply_pass version-bump cache invalidation, transitive liveness in
+DeadOpEliminationPass, and the AnalysisPass read-only contract."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static.passes import (AnalysisPass,
+                                      DeadOpEliminationPass, Pass,
+                                      PassRegistry, apply_pass,
+                                      live_op_slice, register_pass,
+                                      registry)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _fresh():
+    return static.Program(), static.Program()
+
+
+def test_registry_duplicate_name_raises():
+    r = PassRegistry()
+
+    class P1(Pass):
+        pass
+
+    r.register("p", P1)
+    with pytest.raises(ValueError, match="already registered"):
+        r.register("p", P1)
+
+
+def test_registry_unknown_name_raises_with_known_list():
+    r = PassRegistry()
+
+    class P1(Pass):
+        pass
+
+    r.register("alpha", P1)
+    with pytest.raises(KeyError, match="alpha"):
+        r.get("nonexistent")
+
+
+def test_global_registry_has_builtin_and_analysis_passes():
+    names = registry.names()
+    assert "dead_op_elimination" in names
+    assert "op_substitution" in names
+    # the analysis suite registers alongside the rewrites
+    assert "dead_var_analysis" in names
+    assert "unfetched_output_analysis" in names
+    assert "op_coverage_analysis" in names
+
+
+def test_register_pass_decorator_sets_name():
+    r_name = "tmp_test_pass_xyz"
+
+    @register_pass(r_name)
+    class TmpPass(Pass):
+        def apply(self, program):
+            return program
+
+    try:
+        assert TmpPass.name == r_name
+        assert isinstance(registry.get(r_name), TmpPass)
+    finally:
+        registry._passes.pop(r_name, None)
+
+
+def test_apply_pass_version_bump_invalidates_replay_cache():
+    """An op-substitution applied AFTER a run takes effect on the
+    next run because apply_pass bumps the program version keyed into
+    the Executor cache."""
+    from paddle_tpu.static.passes import OpSubstitutionPass
+
+    main, startup = _fresh()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        y = paddle.nn.functional.relu(x)
+    exe = static.Executor()
+    xv = np.ones((2, 2), np.float32)
+    v0 = getattr(main, "_version", 0)
+    o1, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(o1, 1.0)
+    n_cached = len(exe._cache)
+    apply_pass(main, OpSubstitutionPass().configure(
+        "relu", lambda v: v * 7.0))
+    assert main._version == v0 + 1
+    o2, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(o2, 7.0)
+    # a NEW cache entry was compiled (old one not silently reused)
+    assert len(exe._cache) == n_cached + 1
+
+
+def test_dead_op_elimination_transitive_in_one_application():
+    """One application keeps the transitively-LIVE chain intact and
+    drops the whole transitively-DEAD chain."""
+    main, startup = _fresh()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        # live chain: x -> a -> b -> out
+        a = paddle.exp(x)
+        b = a * 2.0
+        out = b + 1.0
+        # dead chain: x -> d1 -> d2 (nothing consumes d2)
+        d1 = paddle.tanh(x)
+        d2 = d1 * 3.0  # noqa: F841
+    assert len(main.global_block().ops) == 5
+    apply_pass(main, DeadOpEliminationPass(keep_vars=[out]))
+    kept_types = [op.type for op in main.global_block().ops]
+    assert len(kept_types) == 3
+    assert "tanh" not in kept_types
+    exe = static.Executor()
+    xv = np.zeros((2, 2), np.float32)
+    o, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(o, 3.0)  # exp(0)*2+1
+
+
+def test_dead_op_elimination_empty_roots_raises():
+    main, startup = _fresh()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        _ = paddle.exp(x)
+    with pytest.raises(ValueError, match="no roots"):
+        apply_pass(main, DeadOpEliminationPass())
+
+
+def test_live_op_slice_shared_helper():
+    main, startup = _fresh()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        a = paddle.exp(x)
+        out = a * 2.0
+        dead = paddle.tanh(x)  # noqa: F841
+    kept, live = live_op_slice(main, [out])
+    assert [op.type for op in kept] == ["exp", "multiply"]
+    assert id(x) in live  # inputs of live ops join the live set
+    # read-only: the program still holds all three ops
+    assert len(main.global_block().ops) == 3
+
+
+def test_analysis_pass_is_read_only_and_stashes_findings():
+    class CountOps(AnalysisPass):
+        def analyze(self, program):
+            from paddle_tpu.analysis import Finding
+
+            n = len(program.global_block().ops)
+            return [Finding("PTA012", f"{n} ops", severity="info")]
+
+    main, startup = _fresh()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 2], "float32")
+        _ = paddle.nn.functional.relu(x)
+    v0 = getattr(main, "_version", 0)
+    p = CountOps()
+    out = apply_pass(main, p)
+    assert out is main
+    assert len(main.global_block().ops) == 1
+    assert getattr(main, "_version", 0) == v0  # no version bump
+    assert p.last_findings[0].message == "1 ops"
