@@ -514,6 +514,13 @@ def main():
         results["analysis"] = {
             k: v for k, v in results["telemetry"]["stats"].items()
             if k.startswith("analysis/")}
+        # failure-forensics health, called out like analysis/: ring
+        # drops, watchdog fires and dump bundles written during the
+        # bench say whether the run was clean or left evidence behind
+        # (ISSUE 3)
+        results["flight"] = {
+            k: v for k, v in results["telemetry"]["stats"].items()
+            if k.startswith("flight/")}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

@@ -32,6 +32,7 @@ from jax import lax
 from .. import profiler as _profiler
 from ..core import monitor as _monitor
 from ..core.engine import apply_op, in_trace_mode
+from ..monitor import flight as _flight
 from ..core.tensor import Tensor
 from . import mesh as mesh_mod
 from .mesh import Group, get_group, new_group_for_axes, world_group
@@ -80,13 +81,38 @@ def _payload_bytes(x):
         return 0
 
 
+def _group_desc(group):
+    """JSON-able group label for flight events: explicit rank list
+    when the group has one, else 'world'."""
+    ranks = getattr(group, "ranks", None)
+    return [int(r) for r in ranks] if ranks else "world"
+
+
+def _group_of(args, kwargs):
+    """The group argument however it was passed — `group=` kwarg or
+    positional (it sits at a different position per collective, so
+    scan for the Group instance rather than hard-coding indices). A
+    wrong label here sends the post-mortem to the wrong ranks."""
+    g = kwargs.get("group")
+    if g is None:
+        for a in args:
+            if isinstance(a, Group):
+                return a
+    return g
+
+
 def _instrumented(op):
-    """Per-collective telemetry (reference: RecordEvent at every c_*
-    op + STAT_ADD comm counters): a `comm/<op>` host span when a
-    profiler is capturing, and `comm/<op>/{calls,bytes,host_us}`
-    registry counters always. `host_us` is host-side dispatch/transport
-    wall time — inside a compiled trace that is trace-time, the device
-    time lives in the XPlane capture."""
+    """Per-collective telemetry + forensics (reference: RecordEvent at
+    every c_* op + STAT_ADD comm counters + the distributed hang
+    diagnosis around collectives): a `comm/<op>` host span when a
+    profiler is capturing, `comm/<op>/{calls,bytes,host_us}` registry
+    counters always, and a flight-recorder in-flight span
+    (collective_begin/_end events with op/group/bytes) so the watchdog
+    can
+    name the exact collective a wedged rank is sitting in — asymmetric
+    participation hangs silently rather than erroring. `host_us` is
+    host-side dispatch/transport wall time — inside a compiled trace
+    that is trace-time, the device time lives in the XPlane capture."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
@@ -107,9 +133,24 @@ def _instrumented(op):
                 nbytes = _payload_bytes(a)
                 if nbytes:
                     break
+            # enabled-check out here: with the kill switch off
+            # (PADDLE_FLIGHT_ENABLE=0) the comm hot path must not
+            # even pay the group scan/label build
+            tok = None
+            if _flight.recorder.enabled:
+                tok = _flight.begin(
+                    "collective", op, bytes=nbytes,
+                    group=_group_desc(_group_of(args, kwargs)))
             t0 = _time.perf_counter()
-            with _profiler.RecordEvent(f"comm/{op}", "Communication"):
-                out = fn(*args, **kwargs)
+            try:
+                with _profiler.RecordEvent(f"comm/{op}",
+                                           "Communication"):
+                    out = fn(*args, **kwargs)
+            finally:
+                # the flight exit must fire even when the collective
+                # raises — a leaked in-flight entry would look like a
+                # permanent hang to the watchdog
+                _flight.end(tok)
             _monitor.stat_add(f"comm/{op}/calls", 1)
             _monitor.stat_add(
                 f"comm/{op}/host_us",

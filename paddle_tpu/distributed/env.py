@@ -14,7 +14,7 @@ import jax
 def get_rank():
     r = os.environ.get("PADDLE_TRAINER_ID")
     if r is not None:
-        return int(r)
+        return int(r)  # malformed launcher env should fail LOUDLY
     try:
         return jax.process_index()
     except Exception:
@@ -29,6 +29,68 @@ def get_world_size():
         return jax.process_count()
     except Exception:
         return 1
+
+
+# -- side-effect-free variants for observability/forensics ------------------
+# get_rank/get_world_size above are the TOPOLOGY truth: they may
+# initialize the jax backend to answer (fleet/mesh callers want that).
+# The peek_* variants below never mutate backend state — required from
+# imports (the monitor exporter autostarts at import time), watchdog
+# threads mid-rendezvous, and crash handlers — at the price of
+# reporting 0/1 until a backend is live, and never raising.
+
+def _jax_ready():
+    """True once reading jax.process_index()/process_count() is
+    side-effect-safe: a backend is initialized, OR jax.distributed
+    is initialized (the rendezvous is done, so backend init is
+    correct). Two independent probes because both read private jax
+    attributes — tests/test_flight.py pins their existence on the
+    pinned jax so an upgrade that moves them fails loudly instead of
+    silently disabling the jax path."""
+    try:
+        from jax._src import xla_bridge
+
+        if bool(getattr(xla_bridge, "_backends", None)):
+            return True
+    except Exception:
+        pass
+    try:
+        from jax._src import distributed as _jdist
+
+        return getattr(getattr(_jdist, "global_state", None),
+                       "client", None) is not None
+    except Exception:
+        return False
+
+
+def peek_rank():
+    try:
+        r = int(os.environ.get("PADDLE_TRAINER_ID", ""))
+    except ValueError:
+        r = None
+    if r is not None:
+        return r
+    if _jax_ready():
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+def peek_world_size():
+    try:
+        w = int(os.environ.get("PADDLE_TRAINERS_NUM", ""))
+    except ValueError:
+        w = None
+    if w is not None:
+        return w
+    if _jax_ready():
+        try:
+            return int(jax.process_count())
+        except Exception:
+            pass
+    return 1
 
 
 def get_local_rank():

@@ -48,15 +48,27 @@ def _maybe_init_jax_distributed():
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=nranks, process_id=rank)
+    from ..monitor import flight as _flight
+
+    # the rendezvous blocks until every rank shows up — a missing peer
+    # is a silent hang, so it rides the watchdog's in-flight registry
+    with _flight.in_flight("bootstrap", "jax_distributed_initialize",
+                           coordinator=coordinator, nranks=nranks):
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nranks,
+                                   process_id=rank)
     _initialized[0] = True
 
 
 def init_parallel_env():
     """Bootstrap: connect to the multi-process world if the launch env
     contract is present, then build the default data-parallel mesh over
-    all (global) devices."""
+    all (global) devices. Arms the flight-recorder watchdog/excepthook
+    first (on by default for distributed runs; PADDLE_FLIGHT_AUTOARM
+    gates) so even a hung coordinator rendezvous leaves evidence."""
+    from ..monitor import flight as _flight
+
+    _flight.maybe_auto_arm("init_parallel_env")
     _maybe_init_jax_distributed()
     mesh_mod.ensure_mesh(dp=-1)
     return ParallelEnv()

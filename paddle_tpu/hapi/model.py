@@ -23,6 +23,7 @@ from .. import profiler as _profiler
 from ..core.tensor import Tensor, to_tensor
 from ..core.engine import no_grad
 from ..io import DataLoader, Dataset
+from ..monitor import flight as _flight
 from . import callbacks as cb_mod
 
 
@@ -137,6 +138,10 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        # failure forensics: distributed fits (or PADDLE_FLIGHT_AUTOARM
+        # =1) get the collective/compile watchdog + crash-bundle
+        # excepthook armed before the first step
+        _flight.maybe_auto_arm("hapi.Model.fit")
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         eval_loader = (self._as_loader(eval_data, batch_size, False, False,

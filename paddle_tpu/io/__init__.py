@@ -20,6 +20,7 @@ import numpy as np
 from .. import profiler as _profiler
 from ..core import monitor as _monitor
 from ..core.tensor import Tensor, to_tensor
+from ..monitor import flight as _flight
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -393,9 +394,10 @@ class DataLoader:
             batch = collate(samples)
             if self.collate_fn is None:
                 batch = _to_device(batch)
+        us = int((_time.perf_counter() - t0) * 1e6)
         _monitor.stat_add("io/batches", 1)
-        _monitor.stat_add("io/fetch_us",
-                          int((_time.perf_counter() - t0) * 1e6))
+        _monitor.stat_add("io/fetch_us", us)
+        _flight.record("io_fetch", n=len(indices), us=us)
         return batch
 
     def _iter_batches(self):
@@ -487,6 +489,7 @@ class DataLoader:
                     except StopIteration:
                         break
                 _monitor.stat_add("io/batches", 1)
+                _flight.record("io_fetch", transport="shm")
                 # zero-copy batches alias the shm ring slot, valid only
                 # until that worker's next batch is fetched. The
                 # default path's _to_device copies host->device before

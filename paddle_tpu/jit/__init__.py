@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 import inspect
 import time as _time
+import weakref
 
 import numpy as np
 import jax
@@ -27,11 +28,13 @@ from .. import profiler as _profiler
 from ..core import engine
 from ..core import monitor as _monitor
 from ..core.tensor import Tensor
+from ..monitor import flight as _flight
 from ..ops import random as _random
 from . import state as _jstate
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TracedLayer",
-           "TrainStepCompiler", "InputSpec", "set_max_loop_iterations"]
+           "TrainStepCompiler", "InputSpec", "set_max_loop_iterations",
+           "cache_report"]
 
 from .dy2static import set_max_loop_iterations  # noqa: E402
 
@@ -116,7 +119,6 @@ def _freeze_static_ex(v, memoize=True):
         pass
     if isinstance(v, np.ndarray):
         import hashlib
-        import weakref
 
         ent = _digest_cache.get(id(v))
         if ent is not None and ent[0]() is v:
@@ -147,6 +149,45 @@ def _freeze_static(v):
 
 
 from .dy2static import source_calls_grad as _source_calls_grad  # noqa: E402
+
+
+# every live compiled callable (StaticFunction / TrainStepCompiler),
+# weakly held — cache_report() walks it so hang/crash dump bundles can
+# show WHAT was compiled and which signatures each cache holds
+_live_compiled = weakref.WeakSet()
+
+
+_CACHE_REPORT_MAX_KEYS = 16
+
+
+def cache_report():
+    """Per-compiled-callable program-cache summary (entry counts + a
+    short repr of the first few cache keys). The flight-recorder dump
+    bundles (monitor.flight.write_dump) embed this so a post-mortem
+    can spot recompile storms — dozens of keys differing in one
+    shape/static arg — without rerunning anything. The key list is
+    capped: in the storm case `entries` carries the signal, and a
+    thousand 200-char reprs would bloat every bundle the watchdog
+    writes mid-incident."""
+    out = []
+    for obj in list(_live_compiled):
+        try:
+            if isinstance(obj, StaticFunction):
+                keys = list(obj._compiled.keys())
+                out.append({"kind": "to_static",
+                            "fn": obj._telemetry_key,
+                            "entries": len(keys),
+                            "keys": [repr(k)[:200] for k in
+                                     keys[:_CACHE_REPORT_MAX_KEYS]]})
+            elif isinstance(obj, TrainStepCompiler):
+                out.append({"kind": "train_step",
+                            "fn": type(obj._model).__name__,
+                            "entries": int(obj._compiled is not None),
+                            "steps": obj._step})
+        except Exception:
+            pass  # a half-torn-down object must not break a dump
+    out.sort(key=lambda d: (d["kind"], d["fn"]))
+    return out
 
 
 def _telemetry_name(func):
@@ -186,6 +227,7 @@ class StaticFunction:
         self._compiled = {}
         # computed once — __call__ is the per-train-step hot path
         self._telemetry_key = _telemetry_name(func)
+        _live_compiled.add(self)
         functools.update_wrapper(self, func,
                                  assigned=("__name__", "__doc__"))
 
@@ -246,6 +288,7 @@ class StaticFunction:
         fname = self._telemetry_key
         entry = self._compiled.get(key)
         compile_ev = None
+        compile_tok = None
         if entry is None:
             # opt-in static analysis at build time (PADDLE_ANALYSIS=1,
             # gated inside the hook): preflight + jaxpr lint of the
@@ -261,15 +304,30 @@ class StaticFunction:
             # on the first jfn invocation (jax.jit is lazy), so the
             # span/timer cover build + first call.
             _monitor.stat_add(f"jit/{fname}/cache_miss", 1)
+            _flight.record("jit_cache_miss", fn=fname)
             compile_ev = _profiler.RecordEvent(
                 f"jit/compile/{fname}", "JitCompile")
             compile_ev.begin()
+            # watchdog-visible compile span (a pathological XLA
+            # compile is a hang from the outside; same lifetime as
+            # compile_ev — build + first lazy jfn invocation)
+            compile_tok = _flight.begin("compile", fname)
             t_compile0 = _time.perf_counter()
-            entry = self._build(target, params, args_treedef, tensor_pos,
-                                static_leaves, arg_sg)
+            try:
+                entry = self._build(target, params, args_treedef,
+                                    tensor_pos, static_leaves, arg_sg)
+            except BaseException:
+                # a failed build must still close the spans — the
+                # finally below is never reached, and a leaked
+                # in-flight compile looks like a permanent hang to
+                # the watchdog
+                compile_ev.end()
+                _flight.end(compile_tok)
+                raise
             self._compiled[key] = entry
         else:
             _monitor.stat_add(f"jit/{fname}/cache_hit", 1)
+            _flight.record("jit_cache_hit", fn=fname)
         try:
             jfn, box = entry
             arg_ts = [flat_args[i] for i in tensor_pos]
@@ -308,6 +366,7 @@ class StaticFunction:
         finally:
             if compile_ev is not None:
                 compile_ev.end()
+                _flight.end(compile_tok)
                 _monitor.stat_add(
                     f"jit/{fname}/compile_us",
                     int((_time.perf_counter() - t_compile0) * 1e6))
@@ -631,6 +690,7 @@ class TrainStepCompiler:
         self._names = None
         self._opt_state = None
         self._step = 0
+        _live_compiled.add(self)
 
     def _params_and_buffers(self):
         params = dict(self._model.named_parameters())
@@ -690,9 +750,11 @@ class TrainStepCompiler:
             # (the per-StaticFunction counters' TrainStepCompiler
             # sibling)
             _monitor.stat_add("jit/train_step/cache_miss", 1)
+            _flight.record("jit_cache_miss", fn="train_step")
             t0 = _time.perf_counter()
             with _profiler.RecordEvent("jit/compile/train_step",
-                                       "JitCompile"):
+                                       "JitCompile"), \
+                    _flight.in_flight("compile", "train_step"):
                 self._build(trainable, frozen, bufs, batch)
                 out = self._run_compiled(trainable, frozen, bufs, batch)
             _monitor.stat_add(
@@ -700,6 +762,7 @@ class TrainStepCompiler:
                 int((_time.perf_counter() - t0) * 1e6))
             return out
         _monitor.stat_add("jit/train_step/cache_hit", 1)
+        _flight.record("jit_cache_hit", fn="train_step")
         return self._run_compiled(trainable, frozen, bufs, batch)
 
     def _run_compiled(self, trainable, frozen, bufs, batch):

@@ -1,6 +1,6 @@
 """paddle_tpu.monitor — unified runtime telemetry hub.
 
-Three pieces (reference: platform/monitor.h StatRegistry + STAT_ADD,
+Four pieces (reference: platform/monitor.h StatRegistry + STAT_ADD,
 platform/profiler/ RecordEvent instrumentation, and the stat-export
 tooling around them):
 
@@ -25,6 +25,13 @@ tooling around them):
     (PADDLE_MONITOR_EXPORT_PATH / _INTERVAL / _FORMAT) so long
     benchmark and multi-host runs leave an inspectable metrics trail
     without code changes.
+
+  * flight (submodule) — always-on failure forensics: a bounded ring
+    of structured runtime events, a collective/compile watchdog that
+    dumps all-thread stacks + the ring tail + a telemetry snapshot
+    when a slice wedges, crash/SIGUSR1 dump bundles, and the
+    `python -m paddle_tpu.monitor` CLI (inspect / merge-traces /
+    tail). See flight.py and the README "Failure forensics" section.
 """
 from __future__ import annotations
 
@@ -34,33 +41,36 @@ import re
 import threading
 import time
 
-from .core.monitor import (  # noqa: F401 — the counter surface
+from ..core.monitor import (  # noqa: F401 — the counter surface
     StatValue, StatRegistry, registry, stat_add, stat_get, stat_set,
     stat_reset, VLOG, vlog_level, device_memory_stats,
     device_memory_in_use,
 )
+from . import flight  # noqa: E402 — the failure-forensics leg
 
 __all__ = [
     "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
     "stat_set", "stat_reset", "VLOG", "vlog_level",
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
-    "get_exporter", "telemetry_snapshot",
+    "get_exporter", "telemetry_snapshot", "flight",
 ]
 
 
 def telemetry_snapshot():
     """Timestamped copy of the full StatRegistry — the record the
-    exporter flushes and bench.py embeds in its `extra` field."""
+    exporter flushes and bench.py embeds in its `extra` field. Syncs
+    the flight ring's amortized counters first so flight/... gauges
+    are exact in every flush/dump."""
+    flight.sync_stats()
     return {"ts": round(time.time(), 3), "rank": _rank(),
             "stats": registry.snapshot()}
 
 
-def _rank():
-    try:
-        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    except ValueError:
-        return 0
+# ONE copy of the launch-env rank parsing, shared with the dump
+# bundles (flight.py owns it; drift here would make exporter rank
+# labels disagree with dump-file rank labels)
+_rank = flight._rank
 
 
 class StepTimer:
@@ -86,6 +96,7 @@ class StepTimer:
 
     def begin_step(self):
         self._t0 = time.perf_counter()
+        flight.record("step_begin")
 
     def end_step(self, batch_size=None, loss=None, lr=None):
         now = time.perf_counter()
@@ -117,7 +128,7 @@ class StepTimer:
             stat_set("step/device_mem_bytes_in_use", used)
             registry.get("step/device_mem_peak_bytes").maximum(peak)
 
-        from . import profiler as _prof
+        from .. import profiler as _prof
 
         if _prof.is_recording():
             _prof.record_counter("step_time_ms", dt * 1e3, ts=now)
@@ -132,6 +143,9 @@ class StepTimer:
                                      ts=now)
         self._last = {"time_s": dt, "batch_size": batch_size,
                       "loss": loss, "lr": lr}
+        flight.record("step_end", us=int(dt * 1e6),
+                      batch_size=batch_size,
+                      loss=None if loss is None else float(loss))
         return dt
 
     def summary(self):
@@ -153,9 +167,34 @@ class StepTimer:
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
 
-def _prom_line(name, value):
-    metric = "paddle_tpu_" + _PROM_BAD.sub("_", name)
-    return f"{metric} {value}"
+def _prom_name(name):
+    return "paddle_tpu_" + _PROM_BAD.sub("_", name)
+
+
+def _prom_lines(items):
+    """Prometheus exposition lines for (name, value) pairs. The `_`
+    substitution is lossy (`step/time` and `step_time` both sanitize
+    to `paddle_tpu_step_time`), so when several stat names land on one
+    metric name EVERY collider gets a suffix derived (sha1) from its
+    ORIGINAL name — no two stats ever alias one Prometheus series.
+    The suffix itself is a pure function of the name; WHETHER a name
+    needs one depends on the name set in the snapshot, which only
+    grows within a process (stat_reset zeroes, never removes) and is
+    identical across ranks running the same code — so series names
+    stay stable except at the moment a brand-new collider first
+    registers."""
+    import hashlib
+
+    sanitized = [(_prom_name(k), k, v) for k, v in items]
+    counts = {}
+    for m, _, _ in sanitized:
+        counts[m] = counts.get(m, 0) + 1
+    lines = []
+    for m, k, v in sanitized:
+        if counts[m] > 1:
+            m = f"{m}_{hashlib.sha1(k.encode()).hexdigest()[:6]}"
+        lines.append(f"{m} {v}")
+    return lines
 
 
 class MetricsExporter:
@@ -167,14 +206,18 @@ class MetricsExporter:
         node-exporter textfile-collector contract: write tmp, rename).
 
     A `{rank}` placeholder in the path expands to the trainer rank so
-    multi-host runs don't clobber one file. The background thread is a
+    multi-host runs don't clobber one file — resolved at FLUSH time,
+    not construction: the env autostart runs at import, before a
+    jax-native multi-host launch knows its rank (expanding then would
+    send every host to `..._0...`). The background thread is a
     daemon; stop() joins it and performs one final flush."""
 
     def __init__(self, path, interval=30.0, fmt=None):
-        self.path = str(path).replace("{rank}", str(_rank()))
+        self._path_template = str(path)
         self.interval = float(interval)
         if fmt is None:
-            fmt = "prom" if self.path.endswith(".prom") else "jsonl"
+            fmt = "prom" if self._path_template.endswith(".prom") \
+                else "jsonl"
         if fmt not in ("jsonl", "prom"):
             raise ValueError(
                 f"MetricsExporter: unknown format {fmt!r} "
@@ -182,36 +225,60 @@ class MetricsExporter:
         self.fmt = fmt
         self._stop = threading.Event()
         self._thread = None
+        self._errors_seen = set()
+
+    @property
+    def path(self):
+        return self._path_template.replace("{rank}", str(_rank()))
 
     def flush(self):
         snap = telemetry_snapshot()
-        d = os.path.dirname(self.path)
+        path = self.path  # one {rank} resolution per flush
+        d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         if self.fmt == "jsonl":
-            with open(self.path, "a") as f:
+            with open(path, "a") as f:
                 f.write(json.dumps(snap) + "\n")
         else:
-            tmp = f"{self.path}.tmp.{os.getpid()}"
-            lines = [_prom_line(k, v)
-                     for k, v in sorted(snap["stats"].items())]
-            lines.append(_prom_line("export_timestamp_seconds",
-                                    snap["ts"]))
+            tmp = f"{path}.tmp.{os.getpid()}"
+            items = sorted(snap["stats"].items())
+            items.append(("export_timestamp_seconds", snap["ts"]))
             with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
-            os.replace(tmp, self.path)
+                f.write("\n".join(_prom_lines(items)) + "\n")
+            os.replace(tmp, path)
         return snap
+
+    def _note_flush_error(self, exc):
+        """Background-flush failure accounting: an unwritable path on
+        a week-long run must be VISIBLE, not a bare `pass` — count
+        every failure under monitor/export/errors (the exporter may
+        recover and flush it later; bench.py embeds it either way) and
+        VLOG each DISTINCT error once so the log isn't flooded at
+        every interval."""
+        stat_add("monitor/export/errors", 1)
+        key = f"{type(exc).__name__}: {exc}"
+        if key not in self._errors_seen:
+            self._errors_seen.add(key)
+            try:
+                VLOG(0, f"MetricsExporter: flush to {self.path} "
+                        f"failed ({key}); will keep retrying")
+            except Exception:
+                # a broken stderr raising INSIDE the error handler
+                # would kill the exporter thread — the exact silent
+                # death this method exists to prevent
+                pass
 
     def _loop(self):
         while not self._stop.wait(self.interval):
             try:
                 self.flush()
-            except Exception:
+            except Exception as e:
                 # an unwritable path OR an unserializable stat value
                 # must not silently kill the exporter thread for the
                 # rest of a long run — keep trying; direct flush()
                 # callers still see the raise
-                pass
+                self._note_flush_error(e)
 
     def start(self):
         if self._thread is not None and self._thread.is_alive():
@@ -231,8 +298,8 @@ class MetricsExporter:
         if flush:
             try:
                 self.flush()
-            except Exception:
-                pass
+            except Exception as e:
+                self._note_flush_error(e)
 
 
 _exporter = None
@@ -261,9 +328,13 @@ def start_exporter(path=None, interval=None, fmt=None):
             interval = 30.0
     fmt = fmt or os.environ.get("PADDLE_MONITOR_EXPORT_FORMAT") or None
     with _exporter_lock:
+        # construct (and so validate fmt/path) BEFORE stopping the
+        # running exporter — a typo'd format must not kill the live
+        # metrics trail and leave a dead object registered
+        new = MetricsExporter(path, interval, fmt)
         if _exporter is not None:
             _exporter.stop(flush=False)
-        _exporter = MetricsExporter(path, interval, fmt).start()
+        _exporter = new.start()
         return _exporter
 
 

@@ -1,0 +1,6 @@
+"""Entry point for `python -m paddle_tpu.monitor`."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

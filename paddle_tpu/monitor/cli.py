@@ -1,0 +1,345 @@
+"""`python -m paddle_tpu.monitor` — offline forensics tooling over the
+artifacts the runtime leaves behind:
+
+  inspect <bundle.json> [--json] [--stacks] [--events N]
+      Pretty-print a flight dump bundle (watchdog / crash / sigusr1 —
+      schema "paddle_tpu.flight/1"); --json re-emits the raw bundle.
+
+  merge-traces -o merged.json rank0.json rank1.json ...
+      Merge per-rank chrome traces (profiler.Profiler.export output)
+      into ONE Perfetto-loadable file: rank r's pids shift by
+      r * stride, with process_name metadata so tracks read
+      "rank1 host" / "rank1 pid1000". The rank comes from a
+      `rank<N>` token in the filename, else the argument position.
+
+  tail <metrics.jsonl> [--keys p1,p2] [--all]
+      Summarize a monitor.MetricsExporter JSON-lines trail: flush
+      cadence per rank + the latest snapshot's interesting stats.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# word boundary before "rank" so e.g. "crank2.json" doesn't parse as
+# rank 2
+_RANK_RE = re.compile(r"(?<![A-Za-z])rank[_-]?(\d+)", re.IGNORECASE)
+
+
+# ---------------------------------------------------------------------------
+# inspect
+# ---------------------------------------------------------------------------
+
+def _fmt_ts(ts):
+    import datetime
+
+    try:
+        return datetime.datetime.fromtimestamp(float(ts)).isoformat(
+            sep=" ", timespec="seconds")
+    except (TypeError, ValueError, OSError, OverflowError):
+        return str(ts)
+
+
+def cmd_inspect(args):
+    with open(args.bundle) as f:
+        bundle = json.load(f)
+    if args.json:
+        json.dump(bundle, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+        return 0
+    out = []
+    reason = bundle.get("reason", "?")
+    out.append(f"== flight dump: {reason} "
+               f"(rank {bundle.get('rank')}, "
+               f"pid {bundle.get('pid')}, "
+               f"host {bundle.get('host')}) ==")
+    out.append(f"schema {bundle.get('schema')}   "
+               f"at {_fmt_ts(bundle.get('ts'))}   "
+               f"world_size {bundle.get('world_size')}")
+    exc = bundle.get("exception")
+    if exc:
+        out.append("")
+        out.append(f"exception: {exc.get('type')}: "
+                   f"{exc.get('message')}")
+        for line in exc.get("traceback") or []:
+            out.append("  " + line.rstrip("\n"))
+    stuck = bundle.get("stuck")
+    if stuck:
+        out.append("")
+        out.append(f"stuck ops (> {bundle.get('timeout_s')}s):")
+        for e in stuck:
+            out.append(f"  {e.get('kind')}/{e.get('name')}  "
+                       f"age {e.get('age_s')}s  tid {e.get('tid')}"
+                       + (f"  bytes {e['bytes']}"
+                          if e.get("bytes") else ""))
+    inflight = bundle.get("in_flight") or []
+    if inflight and not stuck:
+        out.append("")
+        out.append("in flight at dump time:")
+        for e in inflight:
+            out.append(f"  {e.get('kind')}/{e.get('name')}  "
+                       f"age {e.get('age_s')}s  tid {e.get('tid')}")
+    threads = bundle.get("threads") or []
+    out.append("")
+    out.append(f"threads: {len(threads)}")
+    for t in threads:
+        stack = t.get("stack") or []
+        if args.stacks:
+            out.append(f"  -- {t.get('name')} (tid {t.get('tid')}):")
+            for line in stack:
+                out.append("  " + line.rstrip("\n"))
+        else:
+            top = stack[-1].strip().splitlines()[0] if stack else "?"
+            out.append(f"  {t.get('name')} (tid {t.get('tid')}): "
+                       f"{top}")
+    if not args.stacks:
+        out.append("  (--stacks for full stacks)")
+    tail_evs = bundle.get("flight_tail") or []
+    shown = tail_evs[-args.events:] if args.events > 0 else []
+    out.append("")
+    out.append(f"flight tail ({len(shown)} of {len(tail_evs)} "
+               "recorded events):")
+    for ev in shown:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("ts", "tid", "kind") and v is not None}
+        out.append(f"  {_fmt_ts(ev.get('ts'))}  "
+                   f"{str(ev.get('kind', '?')):<18s} "
+                   + " ".join(f"{k}={v}" for k, v in extra.items()))
+    tele = bundle.get("telemetry") or {}
+    stats = tele.get("stats") if isinstance(tele, dict) else None
+    if stats:
+        out.append("")
+        out.append(f"telemetry: {len(stats)} stats; highlights:")
+        for k in sorted(stats):
+            if k.startswith(("step/", "flight/", "monitor/export")):
+                out.append(f"  {k} = {stats[k]}")
+    caches = bundle.get("jit_caches")
+    if isinstance(caches, list) and caches:
+        out.append("")
+        out.append("jit program caches:")
+        for c in caches:
+            out.append(f"  {c.get('kind')}:{c.get('fn')}  "
+                       f"entries={c.get('entries')}")
+    print("\n".join(out))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# merge-traces
+# ---------------------------------------------------------------------------
+
+def _rank_of(path, position):
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else position
+
+
+def cmd_merge_traces(args):
+    # resolve every rank up front: mixing filename-token and
+    # positional assignment can collide (trace_rank1.json + an
+    # unnamed file at position 1), which would silently interleave
+    # two ranks' events under one pid space — refuse instead
+    ranks = [_rank_of(path, pos)
+             for pos, path in enumerate(args.traces)]
+    dup = {r for r in ranks if ranks.count(r) > 1}
+    if dup:
+        print("merge-traces: inputs resolve to duplicate rank(s) "
+              f"{sorted(dup)}: "
+              + ", ".join(f"{p} -> rank{r}"
+                          for p, r in zip(args.traces, ranks))
+              + " — rename the files with distinct rankN tokens",
+              file=sys.stderr)
+        return 2
+    loaded = []
+    for path, rank in zip(args.traces, ranks):
+        with open(path) as f:
+            trace = json.load(f)
+        evs = trace.get("traceEvents", trace) \
+            if isinstance(trace, dict) else trace
+        if not isinstance(evs, list):
+            print(f"merge-traces: {path}: no traceEvents list",
+                  file=sys.stderr)
+            return 1
+        loaded.append((rank, evs))
+    # a pid >= stride would silently cross into the next rank's
+    # shifted block (real OS pids can exceed the default 100000) —
+    # widen the stride to keep rank pid spaces disjoint
+    max_pid = max((ev["pid"] for _, evs in loaded for ev in evs
+                   if isinstance(ev, dict)
+                   and isinstance(ev.get("pid"), int)), default=0)
+    stride = args.pid_stride
+    if max_pid >= stride:
+        stride = 10 ** len(str(max_pid))
+        print(f"merge-traces: input pid {max_pid} >= stride "
+              f"{args.pid_stride}; widening stride to {stride}",
+              file=sys.stderr)
+    merged = []
+    for rank, evs in loaded:
+        base = rank * stride
+        seen_pids = set()
+        named_pids = set()
+        for ev in evs:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            pid = ev.get("pid")
+            if isinstance(pid, int):
+                ev["pid"] = base + pid
+                seen_pids.add(pid)
+                if ev.get("ph") == "M" \
+                        and ev.get("name") == "process_name":
+                    # input already labels this pid (e.g. the XPlane
+                    # '/device:TPU:0' names) — prefix the rank, and
+                    # DON'T synthesize a generic label below (viewers
+                    # take the last process_name per pid)
+                    named_pids.add(pid)
+                    a = ev.get("args")
+                    if isinstance(a, dict) and a.get("name"):
+                        a["name"] = f"rank{rank} {a['name']}"
+            elif pid is None:
+                # pid-less events still need a disjoint-per-rank home
+                ev["pid"] = base
+                seen_pids.add(0)
+            else:
+                # string pids (named process groups): keep the name,
+                # make it rank-unique
+                ev["pid"] = f"rank{rank}/{pid}"
+            ev.setdefault("args", {})
+            if isinstance(ev["args"], dict):
+                ev["args"].setdefault("rank", rank)
+            merged.append(ev)
+        # Perfetto labels: one named process group per (rank, pid)
+        for pid in sorted(seen_pids - named_pids):
+            label = f"rank{rank} host" if pid == 0 \
+                else f"rank{rank} pid{pid}"
+            merged.append({"ph": "M", "name": "process_name",
+                           "pid": base + pid, "tid": 0,
+                           "args": {"name": label}})
+    out = {"traceEvents": merged,
+           "metadata": {"merged_ranks": ranks,
+                        "pid_stride": stride}}
+    with open(args.output, "w") as f:
+        json.dump(out, f)
+    print(f"merged {len(args.traces)} trace(s), ranks {ranks}, "
+          f"{len(merged)} events -> {args.output}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tail
+# ---------------------------------------------------------------------------
+
+_DEFAULT_KEY_PREFIXES = ("step/", "flight/", "monitor/export",
+                         "jit/train_step")
+
+
+def cmd_tail(args):
+    prefixes = tuple(p for p in (args.keys or "").split(",") if p) \
+        or _DEFAULT_KEY_PREFIXES
+    per_rank = {}
+    bad = total = 0
+    with open(args.jsonl) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            total += 1
+            r = rec.get("rank", 0)
+            ent = per_rank.setdefault(
+                r, {"count": 0, "first_ts": rec.get("ts"),
+                    "last": None})
+            ent["count"] += 1
+            ent["last"] = rec
+    if not per_rank:
+        print(f"{args.jsonl}: no valid exporter records"
+              + (f" ({bad} unparsable lines)" if bad else ""))
+        return 1
+    print(f"{args.jsonl}: {total} flushes from "
+          f"{len(per_rank)} rank(s)"
+          + (f", {bad} unparsable line(s)" if bad else ""))
+    for r in sorted(per_rank):
+        ent = per_rank[r]
+        last = ent["last"]
+        span = (last.get("ts") or 0) - (ent["first_ts"] or 0)
+        print(f"\nrank {r}: {ent['count']} flushes over "
+              f"{span:.1f}s, last at {_fmt_ts(last.get('ts'))}")
+        stats = last.get("stats") or {}
+        keys = sorted(k for k in stats
+                      if args.all or k.startswith(prefixes))
+        for k in keys:
+            print(f"  {k} = {stats[k]}")
+        if not keys:
+            print(f"  ({len(stats)} stats; none match "
+                  f"{','.join(prefixes)} — use --all)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.monitor",
+        description="Failure-forensics CLI: inspect flight dump "
+                    "bundles, merge per-rank chrome traces, summarize "
+                    "exporter metrics trails.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser(
+        "inspect", help="pretty-print a flight dump bundle")
+    pi.add_argument("bundle", help="path to a *_rank*_pid*.json dump")
+    pi.add_argument("--json", action="store_true",
+                    help="emit the raw bundle JSON")
+    pi.add_argument("--stacks", action="store_true",
+                    help="full per-thread stacks")
+    pi.add_argument("--events", type=int, default=20,
+                    help="flight-tail events to show (default 20)")
+    pi.set_defaults(fn=cmd_inspect)
+
+    pm = sub.add_parser(
+        "merge-traces",
+        help="merge per-rank chrome traces into one Perfetto file")
+    pm.add_argument("traces", nargs="+",
+                    help="per-rank trace JSONs (rank from a rankN "
+                         "filename token, else argument order)")
+    pm.add_argument("-o", "--output", required=True,
+                    help="merged trace path")
+    pm.add_argument("--pid-stride", type=int, default=100000,
+                    help="pid offset per rank (default 100000)")
+    pm.set_defaults(fn=cmd_merge_traces)
+
+    pt = sub.add_parser(
+        "tail", help="summarize a MetricsExporter .jsonl trail")
+    pt.add_argument("jsonl", help="exporter output file")
+    pt.add_argument("--keys",
+                    help="comma-separated stat-name prefixes to show")
+    pt.add_argument("--all", action="store_true",
+                    help="show every stat in the latest snapshot")
+    pt.set_defaults(fn=cmd_tail)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # output piped into `head`/`less` that exited — not an error;
+        # point stdout at devnull so the interpreter's exit-time flush
+        # doesn't print a second traceback
+        os.dup2(os.open(os.devnull, os.O_WRONLY),
+                sys.stdout.fileno())
+        return 0
+    except (OSError, ValueError) as e:
+        # missing/unreadable/non-JSON input: the clean `error: ...` /
+        # exit-2 contract the analysis CLI established — an operator
+        # mid-incident gets a message, not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

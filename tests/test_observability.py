@@ -359,6 +359,98 @@ def test_start_exporter_env_config(tmp_path, monkeypatch):
     assert mon.get_exporter() is None
 
 
+def test_start_exporter_bad_fmt_keeps_running_exporter(tmp_path):
+    """A typo'd format must not kill the live metrics trail: the new
+    exporter is validated BEFORE the old one stops."""
+    import pytest as _pytest
+
+    from paddle_tpu import monitor as umon
+
+    old = umon.start_exporter(str(tmp_path / "good.jsonl"),
+                              interval=3600)
+    try:
+        with _pytest.raises(ValueError):
+            umon.start_exporter(str(tmp_path / "new.jsonl"),
+                                interval=3600, fmt="prometheus")
+        assert umon.get_exporter() is old
+        assert old._thread is not None and old._thread.is_alive()
+    finally:
+        umon.stop_exporter(flush=False)
+
+
+def test_exporter_rank_placeholder_resolved_at_flush(tmp_path,
+                                                     monkeypatch):
+    """{rank} resolves per flush, not at construction — the import-
+    time autostart runs before a jax-native multi-host launch knows
+    its rank."""
+    from paddle_tpu import monitor as umon
+
+    monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+    exp = umon.MetricsExporter(str(tmp_path / "m_{rank}.jsonl"),
+                               interval=3600)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "5")  # rank learned late
+    exp.flush()
+    assert (tmp_path / "m_5.jsonl").exists()
+
+
+def test_prom_name_collisions_deduped(tmp_path):
+    """`step/time` and `step_time` both sanitize to
+    paddle_tpu_step_time — the exporter must emit two DISTINCT series
+    (stable hash suffixes) instead of silently aliasing them."""
+    from paddle_tpu import monitor as umon
+
+    monitor.stat_reset()
+    monitor.stat_set("step/time", 1)
+    monitor.stat_set("step_time", 2)
+    monitor.stat_add("comm/all_reduce/calls", 3)
+    path = tmp_path / "collide.prom"
+    umon.MetricsExporter(str(path)).flush()
+    lines = [l for l in path.read_text().splitlines()
+             if l.startswith("paddle_tpu_step_time")]
+    assert len(lines) == 2
+    names = {l.split()[0] for l in lines}
+    assert len(names) == 2, f"aliased: {lines}"
+    assert sorted(int(l.split()[1]) for l in lines) == [1, 2]
+    # stable across flushes (suffix derives from the original name)
+    umon.MetricsExporter(str(path)).flush()
+    again = {l.split()[0] for l in path.read_text().splitlines()
+             if l.startswith("paddle_tpu_step_time")}
+    assert again == names
+    # uncollided names keep the plain sanitized form
+    assert "paddle_tpu_comm_all_reduce_calls 3" in path.read_text()
+
+
+def test_exporter_flush_errors_logged_and_counted(tmp_path, capsys):
+    """A background flush failing (unwritable path) must not be
+    silent: monitor/export/errors counts every failure, and each
+    DISTINCT error VLOGs exactly once — not at every interval."""
+    import time as _t
+
+    from paddle_tpu import monitor as umon
+
+    monitor.stat_reset()
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    # dirname is a regular file -> makedirs/open fails every flush
+    exp = umon.MetricsExporter(str(blocker / "m.jsonl"), interval=0.02)
+    exp.start()
+    try:
+        deadline = _t.time() + 10
+        while (monitor.stat_get("monitor/export/errors") < 2
+               and _t.time() < deadline):
+            _t.sleep(0.02)
+    finally:
+        exp.stop(flush=False)
+    assert monitor.stat_get("monitor/export/errors") >= 2
+    err = capsys.readouterr().err
+    assert err.count("MetricsExporter: flush") == 1
+    # direct flush() callers still see the raise
+    import pytest as _pytest
+
+    with _pytest.raises(OSError):
+        exp.flush()
+
+
 def test_step_timer_populates_step_stats():
     import paddle_tpu.monitor as mon
 
