@@ -124,6 +124,38 @@ def bench_mnist(on_tpu):
                  "the number measures the harness round-trip, not the "
                  "framework — do not read vs_baseline as a win "
                  "(r4 verdict weak #5)")
+
+    # fused multi-step dispatch: K train steps scanned through ONE XLA
+    # program (steps_per_dispatch) — the per-step host round-trip this
+    # probe is bound by amortizes over K, so the ratio
+    # fused/vs-unfused IS the dispatch overhead the r5 verdict flagged
+    K = 8
+    paddle.seed(0)
+    net_f = LeNet()
+    opt_f = optim.Adam(learning_rate=1e-3,
+                       parameters=net_f.parameters())
+    step_f = TrainStepCompiler(net_f, opt_f, lambda o, y: ce(o, y),
+                               steps_per_dispatch=K)
+    xs = paddle.to_tensor(
+        rng.randn(K, batch, 1, 28, 28).astype(np.float32))
+    ys = paddle.to_tensor(
+        rng.randint(0, 10, (K, batch)).astype(np.int64))
+    n_disp = max(1, steps // K)
+    for _ in range(max(1, warmup // 2)):
+        lv = step_f(xs, ys)
+    first_f = float(np.asarray(lv._value)[0])
+    dts_f = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(n_disp):
+            lv = step_f(xs, ys)
+        last_f = float(np.asarray(lv._value)[-1])  # sync
+        dts_f.append((time.perf_counter() - t0) / n_disp)
+    _check_decreasing("mnist_fused", first_f, last_f)
+    dt_f = float(np.median(dts_f))
+    r["steps_per_dispatch"] = K
+    r["fused_imgs_s"] = round(batch * K / dt_f, 1)
+    r["fused_speedup"] = round((batch * K / dt_f) / (batch / dt), 3)
     return r
 
 
@@ -287,9 +319,13 @@ def bench_resnet50_pipeline(on_tpu):
 
     loader = DataLoader(ds, batch_size=batch, num_workers=4,
                         use_shared_memory=True, drop_last=True,
-                        persistent_workers=True)
-    # (2) e2e: loader feeding the compiled step (few steps — each
-    # carries a tunnel-bound 77 MB H2D in this harness)
+                        persistent_workers=True,
+                        prefetch_to_device=2)
+    # (2) e2e: loader feeding the compiled step through the async
+    # device-feed stage (prefetch_to_device=2): H2D for batch i+1
+    # issues from a background thread while the chip runs batch i
+    # (few steps — each carries a tunnel-bound 77 MB H2D in this
+    # harness)
     steps, warmup, windows = (4, 1, 2) if on_tpu else (2, 1, 1)
     it = iter(loader)
     dts = []
@@ -319,6 +355,7 @@ def bench_resnet50_pipeline(on_tpu):
     r["loader_view_imgs_s"] = view_rate
     r["loader_imgs_s"] = loader_rate
     r["host_cpus"] = os.cpu_count()
+    r["prefetch_to_device"] = 2
     # the sustains-the-device-rate claim is checked, not asserted:
     # record truthfully whether the owned-batch rate meets the
     # synthetic device rate measured by the resnet50 config (r4 weak
@@ -521,6 +558,15 @@ def main():
         results["flight"] = {
             k: v for k, v in results["telemetry"]["stats"].items()
             if k.startswith("flight/")}
+        # latency-hiding pipeline attribution (ISSUE 4): how many XLA
+        # dispatches covered how many train steps, and what the device
+        # prefetcher moved/hid — the counters that say WHERE a
+        # throughput delta came from
+        results["pipeline"] = {
+            k: v for k, v in results["telemetry"]["stats"].items()
+            if k.startswith("io/device_prefetch/")
+            or k in ("io/h2d_us", "jit/dispatches", "jit/steps",
+                     "jit/steps_per_dispatch")}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

@@ -104,9 +104,31 @@ class LocalSGDOptimizer:
             "compiled step) or run the eager loop with opt.step()")
 
     def _ensure_snapshots(self, params):
-        if self._snapshots is None:
-            self._snapshots = {
-                id(p): np.asarray(p._value).copy() for p in params}
+        if self._snapshots is not None:
+            return
+        from ... import env as dist_env
+
+        if dist_env.get_world_size() > 1:
+            # initial-consistency guard (reference
+            # init_snapshot_vars runs AFTER fleet broadcast startup):
+            # replicas that begin from different parameters make the
+            # delta-average reconstruct param = snapshot - avg_delta
+            # against per-rank snapshots that never agree — the run
+            # silently converges to a rank-dependent mix. Broadcast
+            # rank 0's parameters before the first snapshot so every
+            # replica starts (and snapshots) identically.
+            from ... import collective as dist
+            from ....core.tensor import Tensor
+
+            for p in params:
+                cur = np.asarray(p._value)
+                t = Tensor(cur.copy())
+                dist.broadcast(t, src=0)
+                new = np.asarray(t._value)
+                if not np.array_equal(new, cur):
+                    p.set_value(new.astype(cur.dtype))
+        self._snapshots = {
+            id(p): np.asarray(p._value).copy() for p in params}
 
     def _communicate(self):
         """param <- snapshot - mean_world(snapshot - param);

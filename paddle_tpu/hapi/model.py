@@ -17,6 +17,8 @@ nothing from a second adapter; hapi stays the dygraph/compiled-step
 front."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .. import profiler as _profiler
@@ -47,6 +49,12 @@ class Model:
         self._loss = None
         self._metrics = []
         self._compiled_step = None
+        self._tail_step = None  # K=1 sibling for fused-fit stragglers
+        self._stale_step = None  # retired compiler whose opt state the
+        # next build adopts (fit-exit accumulation demotion)
+        self._fit_accum = 1     # fit(accumulate_grad_batches=...)
+        self._accum_seen = 0    # dygraph-fallback accumulation counter
+        self._fused_disabled = False  # a fused dispatch failed: latch
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -62,47 +70,203 @@ class Model:
         return self
 
     # -- single-batch APIs ------------------------------------------------
+    def _make_compiled_step(self, steps_per_dispatch=1):
+        """TrainStepCompiler over the model/loss/optimizer triple —
+        distributed when a live mesh is present (dp-in-fit, the
+        reference fleet Model path), plain otherwise."""
+        from ..distributed import mesh as mesh_mod
+
+        mesh = mesh_mod.get_mesh()
+        loss_fn = (lambda out, lbl:
+                   self._compute_loss(out, [lbl]))
+        if mesh is not None and mesh.size > 1:
+            from ..jit.distributed import DistributedTrainStepCompiler
+
+            return self._adopt_stale(DistributedTrainStepCompiler(
+                self.network, self._optimizer, loss_fn, mesh=mesh,
+                steps_per_dispatch=steps_per_dispatch,
+                accumulate_steps=self._fit_accum))
+        from ..jit import TrainStepCompiler
+
+        comp = TrainStepCompiler(
+            self.network, self._optimizer, loss_fn,
+            steps_per_dispatch=steps_per_dispatch,
+            accumulate_steps=self._fit_accum)
+        return self._adopt_stale(comp)
+
+    def _adopt_stale(self, comp):
+        """A retired compiler (e.g. stashed at the end of an
+        accumulate_grad_batches fit) hands its live optimizer state to
+        the first compiler built after it — training continues one
+        coherent stream instead of restarting slots."""
+        stale, self._stale_step = self._stale_step, None
+        if stale is not None:
+            comp.adopt_state_from(stale)
+        return comp
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = self._to_list(inputs)
         labels = self._to_list(labels)
         if self._compiled_step is None and update and self._loss is not None:
             try:
-                from ..distributed import mesh as mesh_mod
-
-                mesh = mesh_mod.get_mesh()
-                loss_fn = (lambda out, lbl:
-                           self._compute_loss(out, [lbl]))
-                if mesh is not None and mesh.size > 1:
-                    # dp-in-fit: live mesh -> distributed step, batch
-                    # sharded over 'dp' (reference fleet Model path)
-                    from ..jit.distributed import (
-                        DistributedTrainStepCompiler)
-
-                    self._compiled_step = DistributedTrainStepCompiler(
-                        self.network, self._optimizer, loss_fn,
-                        mesh=mesh)
-                else:
-                    from ..jit import TrainStepCompiler
-
-                    self._compiled_step = TrainStepCompiler(
-                        self.network, self._optimizer, loss_fn)
+                self._compiled_step = self._make_compiled_step()
             except Exception:
                 self._compiled_step = False
-        if self._compiled_step:
+        # update=False is a loss probe: the compiled step ALWAYS
+        # applies the optimizer, so it must not run (it used to,
+        # silently mutating params on a supposedly read-only call)
+        if self._compiled_step and update:
+            if getattr(self._compiled_step,
+                       "_steps_per_dispatch", 1) != 1:
+                # a fused (K>1) program can't take ONE batch — route
+                # through the state-sharing K=1 sibling, NOT the
+                # dygraph fallback (whose eager optimizer slots never
+                # saw the compiled updates and would fork the state)
+                return self._train_batch_tail(inputs, labels)
             avals = [x._value for x in inputs] + [l._value for l in labels]
             try:
                 loss = self._compiled_step(*avals)
                 return [float(loss.item())]
             except Exception:
                 self._compiled_step = False
+        return self._train_batch_eager(inputs, labels, update)
+
+    def _train_batch_eager(self, inputs, labels, update):
+        """Dygraph tape fallback (lists already normalized)."""
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         if update:
             loss.backward()
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            self._accum_seen += 1
+            if self._accum_seen % self._fit_accum == 0:
+                if self._fit_accum > 1:
+                    # tape grads summed over the window: average them
+                    # to match the compiled path's gradient merge
+                    inv = 1.0 / self._fit_accum
+                    for p in self.network.parameters():
+                        if p._grad is not None:
+                            p._grad = Tensor(p._grad._value * inv,
+                                             stop_gradient=True,
+                                             _internal=True)
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         return [float(loss.item())]
+
+    def _train_batch_fused(self, group):
+        """One fused dispatch over a group of K buffered (inputs,
+        labels) pairs: each tensor position is stacked along a new
+        leading K axis and handed to a steps_per_dispatch=K compiled
+        step (ONE XLA program runs all K train steps on device).
+        Returns the K per-microstep losses, or None when the fused
+        path is unavailable (no loss/optimizer, build failure, ragged
+        tail shapes) — the caller then steps the group sequentially."""
+        if self._fused_disabled:
+            return None  # don't rebuild (and recompile) a program
+            # that already failed once — the K=1 demotion stands
+        self.network.train()
+        k = len(group)
+        if self._compiled_step is None and self._loss is not None:
+            try:
+                self._compiled_step = self._make_compiled_step(
+                    steps_per_dispatch=k)
+            except Exception:
+                self._compiled_step = False
+        step = self._compiled_step
+        if step and getattr(step, "_steps_per_dispatch", 1) != k:
+            # a compiled step of a DIFFERENT width already exists (a
+            # train_batch call before fit, or a previous fit with
+            # another K): build the K-wide program around ITS live
+            # optimizer state instead of silently never fusing; a K=1
+            # predecessor becomes the tail sibling.
+            try:
+                fused = self._make_compiled_step(steps_per_dispatch=k)
+                fused.adopt_state_from(step)
+                if (getattr(step, "_steps_per_dispatch", 1) == 1
+                        and self._tail_step is None):
+                    self._tail_step = step
+                self._compiled_step = step = fused
+            except Exception:
+                return None
+        if not step:
+            return None
+        rows = [self._to_list(ins) + self._to_list(lbls)
+                for ins, lbls in group]
+        sigs = [[(tuple(t.shape), str(t.dtype)) for t in row]
+                for row in rows]
+        if any(s != sigs[0] for s in sigs[1:]):
+            # ragged group (short last batch, or a stray dtype that
+            # jnp.stack would silently promote into a signature the
+            # compiled program rejects): sequential fallback for THIS
+            # group only, the fused program stays live
+            return None
+        import jax.numpy as jnp
+
+        try:
+            avals = [jnp.stack([row[j]._value for row in rows])
+                     for j in range(len(rows[0]))]
+            losses = step(*avals)
+            return [float(v) for v in np.asarray(losses._value)]
+        except Exception:
+            # the fused program failed: demote to a K=1 compiled
+            # sibling that ADOPTS its live optimizer state — one bad
+            # dispatch must not silently fork the whole fit onto the
+            # eager path with fresh optimizer slots
+            self._fused_disabled = True
+            dead, self._compiled_step = self._compiled_step, False
+            tail = self._tail_step
+            if tail is None:
+                try:
+                    tail = self._make_compiled_step(1)
+                except Exception:
+                    tail = False
+                self._tail_step = tail
+            if tail:
+                tail.adopt_state_from(dead)
+                self._compiled_step = tail
+            else:
+                # the K=1 rebuild failed too: the rest of the fit runs
+                # eager with fresh optimizer slots — that state fork
+                # must not be silent
+                import warnings
+
+                warnings.warn(
+                    "fused dispatch failed and no compiled fallback "
+                    "could be built; continuing in dygraph mode with "
+                    "fresh optimizer state", RuntimeWarning)
+            return None
+
+    def _train_batch_tail(self, inputs, labels):
+        """A straggler batch in a fused fit (short tail group): runs
+        through a K=1 compiled sibling that ADOPTS the fused step's
+        live optimizer state (and hands it back after), so momentum/
+        Adam slots stay one coherent stream across fused and tail
+        steps — the dygraph fallback keeps its own state and would
+        silently fork it."""
+        fused = self._compiled_step
+        if fused and getattr(fused, "_steps_per_dispatch", 1) > 1:
+            self.network.train()
+            inputs = self._to_list(inputs)
+            labels = self._to_list(labels)
+            if self._tail_step is None:
+                try:
+                    self._tail_step = self._make_compiled_step(1)
+                except Exception:
+                    self._tail_step = False
+            if self._tail_step:
+                try:
+                    self._tail_step.adopt_state_from(fused)
+                    avals = ([x._value for x in inputs]
+                             + [l._value for l in labels])
+                    loss = self._tail_step(*avals)
+                    fused.adopt_state_from(self._tail_step)
+                    return [float(loss.item())]
+                except Exception:
+                    self._tail_step = False
+            # no usable sibling: eager directly — going back through
+            # train_batch would re-route here forever (fused is live)
+            return self._train_batch_eager(inputs, labels, True)
+        return self.train_batch(inputs, labels)
 
     def _compute_loss(self, outputs, labels):
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
@@ -137,11 +301,50 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            steps_per_dispatch=None):
+        """steps_per_dispatch=K>1 buffers K loader batches and runs
+        them as ONE fused compiled dispatch (jit.TrainStepCompiler's
+        lax.scan path) — per-batch callbacks still fire once per
+        microstep, with each microstep's own loss. Default comes from
+        PADDLE_JIT_STEPS_PER_DISPATCH (else 1). num_iters may overshoot
+        by up to K-1 steps (a dispatched group is indivisible).
+
+        accumulate_grad_batches=A averages gradients over A batches
+        per optimizer step (TrainStepCompiler's gradient merge on the
+        compiled path; deferred step + grad averaging on the dygraph
+        fallback). Composes with steps_per_dispatch."""
         # failure forensics: distributed fits (or PADDLE_FLIGHT_AUTOARM
         # =1) get the collective/compile watchdog + crash-bundle
         # excepthook armed before the first step
         _flight.maybe_auto_arm("hapi.Model.fit")
+        accum = max(1, int(accumulate_grad_batches))
+        self._fit_accum = accum
+        self._accum_seen = 0  # never inherit a partial eager window
+        for attr in ("_compiled_step", "_tail_step"):
+            step = getattr(self, attr)
+            if step and getattr(step, "_accum_steps", 1) != accum:
+                # a live compiled step baked a DIFFERENT merge width
+                # into its program + accumulation buffers; rebuild
+                # (fresh optimizer state — matches a fresh fit)
+                import warnings
+
+                warnings.warn(
+                    "accumulate_grad_batches changed with a live "
+                    "compiled step; rebuilding it (optimizer slot "
+                    "state restarts)", RuntimeWarning)
+                setattr(self, attr, None)
+        if steps_per_dispatch is None:
+            try:
+                steps_per_dispatch = int(os.environ.get(
+                    "PADDLE_JIT_STEPS_PER_DISPATCH") or 1)
+            except ValueError:
+                steps_per_dispatch = 1
+        k_fused = max(1, int(steps_per_dispatch))
+        # the fused-failure latch spans ONE fit: a fresh fit() (maybe
+        # after a transient failure, maybe with a different K) gets a
+        # fresh attempt; a failure inside it latches again
+        self._fused_disabled = False
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         eval_loader = (self._as_loader(eval_data, batch_size, False, False,
@@ -157,38 +360,90 @@ class Model:
                                            m.name() for m in self._metrics])
         cbks.on_begin("train")
         iters_done = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            for step, batch in enumerate(loader):
-                ins, lbls = self._split_batch(batch)
-                bs = _batch_size_of(ins)
-                cbks.on_batch_begin("train", step, {"batch_size": bs})
-                # per-step host span (reference: RecordEvent around the
-                # trainer loop body) — batch size rides in args so the
-                # chrome trace shows it per step
+        loss = [0.0]
+        pending = []  # buffered (step, ins, lbls, bs) awaiting dispatch
+
+        def _flush_pending():
+            """Dispatch buffered batches: one fused program when the
+            group is full and stackable, sequential train_batch
+            otherwise. Fires the per-batch callback pair for every
+            microstep either way."""
+            nonlocal loss, iters_done
+            if not pending:
+                return
+            fused = None
+            if k_fused > 1 and len(pending) == k_fused:
                 with _profiler.RecordEvent(
-                        "hapi/train_step", "TrainStep",
-                        args={"batch_size": bs} if bs else None):
-                    loss = self.train_batch(ins, lbls)
-                logs = {"loss": loss[0], "step": step,
-                        "batch_size": bs}
-                cbks.on_batch_end("train", step, logs)
+                        "hapi/fused_dispatch", "TrainStep",
+                        args={"steps": k_fused}):
+                    fused = self._train_batch_fused(
+                        [(ins, lbls) for _, ins, lbls, _ in pending])
+            for idx, (s2, ins2, lbls2, b2) in enumerate(pending):
+                cbks.on_batch_begin("train", s2, {"batch_size": b2})
+                if fused is not None:
+                    loss = [fused[idx]]
+                else:
+                    with _profiler.RecordEvent(
+                            "hapi/train_step", "TrainStep",
+                            args={"batch_size": b2} if b2 else None):
+                        loss = self._train_batch_tail(ins2, lbls2)
+                cbks.on_batch_end("train", s2,
+                                  {"loss": loss[0], "step": s2,
+                                   "batch_size": b2})
                 iters_done += 1
+            pending.clear()
+
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                for step, batch in enumerate(loader):
+                    ins, lbls = self._split_batch(batch)
+                    bs = _batch_size_of(ins)
+                    # ONE step path for every K: batches buffer into
+                    # K-sized groups and _flush_pending fires the
+                    # per-batch callback pair — K=1 groups simply
+                    # flush (sequentially) on every batch
+                    pending.append((step, ins, lbls, bs))
+                    if len(pending) >= k_fused:
+                        _flush_pending()
+                        if (num_iters is not None
+                                and iters_done >= num_iters):
+                            break
+                _flush_pending()  # ragged/short tail group
+                cbks.on_epoch_end(epoch, {"loss": loss[0]})
+                if eval_loader is not None \
+                        and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=0)
+                if save_dir is not None and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/epoch_{epoch}")
+                if self.stop_training:
+                    break
                 if num_iters is not None and iters_done >= num_iters:
                     break
-            cbks.on_epoch_end(epoch, {"loss": loss[0]})
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=0)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
-            if self.stop_training:
-                break
-            if num_iters is not None and iters_done >= num_iters:
-                break
-        cbks.on_end("train")
+            cbks.on_end("train")
+        finally:
+            # fit-scoped accumulation state must not leak: a partial
+            # eager window (grads from < A batches) is dropped, and
+            # train_batch() after fit keeps step-per-call semantics
+            if self._fit_accum > 1:
+                if self._accum_seen % self._fit_accum != 0 \
+                        and self._optimizer is not None:
+                    self._optimizer.clear_grad()
+                # a surviving compiled step merges every A calls — a
+                # post-fit train_batch() must not silently skip 3 of
+                # 4 optimizer updates. Retire it; the next build (any
+                # width) adopts its optimizer state, dropping the
+                # partial merge window like the eager one above.
+                live = self._compiled_step or self._tail_step
+                if live:
+                    self._stale_step = live
+                self._compiled_step = None
+                self._tail_step = None
+            self._fit_accum = 1
+            self._accum_seen = 0
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

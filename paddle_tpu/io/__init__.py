@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 import time as _time
@@ -309,22 +310,29 @@ def _detach_views(obj):
 _cpu_backend = None
 
 
+def _is_cpu_backend():
+    global _cpu_backend
+    if _cpu_backend is None:
+        import jax
+
+        _cpu_backend = jax.default_backend() == "cpu"
+    return _cpu_backend
+
+
+def _own_for_cpu(arr):
+    """jax's CPU client zero-copies 64B-aligned numpy arrays into
+    device buffers — a shm-ring slot view would then alias the ring
+    past slot reuse/munmap (verified: mutating the backing buffer
+    changes the "device" array). Detach views on the CPU backend; an
+    accelerator device_put always copies off-host."""
+    if arr.base is not None and _is_cpu_backend():
+        return arr.copy()
+    return arr
+
+
 def _to_device(obj):
     if isinstance(obj, np.ndarray):
-        # jax's CPU client zero-copies 64B-aligned numpy arrays into
-        # device buffers — a shm-ring slot view would then alias the
-        # ring past slot reuse/munmap (verified: mutating the backing
-        # buffer changes the "device" array). Detach views on the CPU
-        # backend; an accelerator device_put always copies off-host.
-        global _cpu_backend
-        if obj.base is not None:
-            if _cpu_backend is None:
-                import jax
-
-                _cpu_backend = jax.default_backend() == "cpu"
-            if _cpu_backend:
-                obj = obj.copy()
-        return to_tensor(obj)
+        return to_tensor(_own_for_cpu(obj))
     if isinstance(obj, tuple):
         return tuple(_to_device(o) for o in obj)
     if isinstance(obj, list):
@@ -332,6 +340,73 @@ def _to_device(obj):
     if isinstance(obj, dict):
         return {k: _to_device(v) for k, v in obj.items()}
     return obj
+
+
+def _batch_mesh_sharding(ndim):
+    """Sharding-aware prefetch placement: with a live single-process
+    mesh whose 'dp' axis is real, batches land pre-sharded over dp on
+    the leading dim — the DistributedTrainStepCompiler's device_put
+    onto the same sharding is then a no-op instead of a re-layout.
+    None (default placement) everywhere else; multi-process meshes
+    need the compiler's hostify path, so they are left alone."""
+    import jax
+
+    try:
+        from ..distributed import mesh as mesh_mod
+
+        mesh = mesh_mod.get_mesh()
+        if (mesh is None or jax.process_count() > 1 or ndim < 1
+                or mesh.shape.get("dp", 1) <= 1):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(
+            mesh, PartitionSpec(*(("dp",) + (None,) * (ndim - 1))))
+    except Exception:
+        return None
+
+
+def _device_put_batch(obj):
+    """Prefetch-stage device placement: non-blocking, sharding-aware
+    device_puts (PJRT overlaps the H2D copy with whatever the main
+    thread is computing). Mirrors _to_device's output contract —
+    ndarray leaves become device-backed Tensors, including Tensor's
+    float64 -> default-float cast (toggling prefetch must never
+    change batch dtypes)."""
+    import jax
+
+    if isinstance(obj, np.ndarray):
+        arr = _own_for_cpu(obj)
+        if arr.dtype == np.float64:
+            from ..core import dtype as _dtype_mod
+
+            arr = arr.astype(_dtype_mod.default_float_dtype())
+        sh = _batch_mesh_sharding(arr.ndim)
+        if sh is not None:
+            try:
+                v = jax.device_put(arr, sh)
+            except Exception:
+                v = jax.device_put(arr)  # e.g. dp doesn't divide batch
+        else:
+            v = jax.device_put(arr)
+        return Tensor(v, stop_gradient=True, _internal=True)
+    if isinstance(obj, tuple):
+        return tuple(_device_put_batch(o) for o in obj)
+    if isinstance(obj, list):
+        return [_device_put_batch(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _device_put_batch(v) for k, v in obj.items()}
+    return obj
+
+
+def _host_nbytes(obj):
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, (tuple, list)):
+        return sum(_host_nbytes(o) for o in obj)
+    if isinstance(obj, dict):
+        return sum(_host_nbytes(v) for v in obj.values())
+    return 0
 
 
 class DataLoader:
@@ -343,14 +418,26 @@ class DataLoader:
     (utils/cpp/shm_ring.cc, the mmap_allocator.cc analog); supports
     worker_init_fn, timeout, and persistent_workers. With
     use_shared_memory=False, a thread prefetcher is used instead
-    (enough when transforms are numpy-light)."""
+    (enough when transforms are numpy-light).
+
+    prefetch_to_device=N adds a device-feed stage: a background thread
+    issues non-blocking, sharding-aware device_puts into a bounded
+    N-deep buffer, so each batch's H2D transfer rides under the
+    previous step's compute instead of blocking the training thread
+    (the BufferedReader double-buffer, moved to the PJRT boundary).
+    Default: on (depth 2) when a non-CPU backend is present, off on
+    CPU; PADDLE_IO_DEVICE_PREFETCH=N overrides (0 disables, N>0
+    forces depth N on any backend). Only default-collate batches are
+    device-placed — a custom collate_fn keeps its raw batches, buffered
+    but untouched. Observable via io/h2d_us and
+    io/device_prefetch/{depth,stalls,bytes} counters."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn
@@ -360,6 +447,8 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
+        self.prefetch_to_device = prefetch_to_device
+        self._pf_orphans = []  # feeder threads outliving their epoch
         self._mp_loader = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -382,7 +471,7 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
-    def _fetch(self, indices):
+    def _fetch(self, indices, to_device=True):
         # io telemetry: this runs on the CALLING thread — under the
         # threaded prefetcher that is the producer thread, whose spans
         # the process-wide recorder now captures (the thread-local
@@ -392,7 +481,7 @@ class DataLoader:
             samples = [self.dataset[i] for i in indices]
             collate = self.collate_fn or _np_collate
             batch = collate(samples)
-            if self.collate_fn is None:
+            if self.collate_fn is None and to_device:
                 batch = _to_device(batch)
         us = int((_time.perf_counter() - t0) * 1e6)
         _monitor.stat_add("io/batches", 1)
@@ -400,13 +489,17 @@ class DataLoader:
         _flight.record("io_fetch", n=len(indices), us=us)
         return batch
 
-    def _iter_batches(self):
+    def _iter_batches(self, to_device=True):
+        # to_device=False yields HOST batches — the device-prefetch
+        # stage owns placement then (it must see numpy to issue the
+        # sharding-aware device_put itself)
+        dev = _to_device if to_device else (lambda b: b)
         if self._iterable_mode:
             it = iter(self.dataset)
             collate = self.collate_fn or _np_collate
             if self.batch_size is None:
                 for sample in it:
-                    yield _to_device(sample)
+                    yield dev(sample)
                 return
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
@@ -415,15 +508,15 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 out = collate(batch)
-                yield out if self.collate_fn is not None else _to_device(out)
+                yield out if self.collate_fn is not None else dev(out)
         elif self.batch_sampler is None:
             for i in range(len(self.dataset)):
-                yield _to_device(_np_collate([self.dataset[i]]))
+                yield dev(_np_collate([self.dataset[i]]))
         else:
             for indices in self.batch_sampler:
-                yield self._fetch(indices)
+                yield self._fetch(indices, to_device=to_device)
 
-    def _multiprocess_iter(self):
+    def _multiprocess_iter(self, to_device=True):
         from .worker import MultiprocessLoader
 
         def make_loader():
@@ -460,7 +553,7 @@ class DataLoader:
                 f"multiprocess DataLoader unavailable ({e}); falling "
                 "back to thread prefetching — pass "
                 "use_shared_memory=False to silence", RuntimeWarning)
-            yield from self._threaded_iter()
+            yield from self._threaded_iter(to_device=to_device)
             return
 
         if self.batch_sampler is not None:
@@ -477,7 +570,8 @@ class DataLoader:
         # detach only when zero-copy transport is on: plain-pickle
         # batches already own immutable bytes-backed data, and copying
         # them would add a gratuitous full-batch memcpy (review)
-        detach = raw and _zero_copy_enabled()
+        detach_host = _zero_copy_enabled()
+        detach = raw and detach_host
         try:
             gen = loader.run_epoch(batches)
             while True:
@@ -499,13 +593,23 @@ class DataLoader:
                 # pickle+ring+unpickle transport).
                 if raw:
                     yield _detach_views(batch) if detach else batch
-                else:
+                elif to_device:
                     yield _to_device(batch)
+                elif detach_host:
+                    # device placement deferred to the prefetch thread,
+                    # which runs AFTER the next ring pop may have
+                    # recycled this slot — hand it an owned copy
+                    yield _detach_views(batch)
+                else:
+                    # zero-copy transport off: plain-pickle batches
+                    # already own their bytes — copying would add a
+                    # gratuitous full-batch memcpy
+                    yield batch
         finally:
             if owned:
                 loader.shutdown()
 
-    def _threaded_iter(self):
+    def _threaded_iter(self, to_device=True):
         # threaded prefetch: producer thread pulls batches, main
         # thread does device_put
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
@@ -513,7 +617,7 @@ class DataLoader:
 
         def producer():
             try:
-                for b in self._iter_batches():
+                for b in self._iter_batches(to_device=to_device):
                     q.put(b)
             finally:
                 q.put(sentinel)
@@ -526,7 +630,162 @@ class DataLoader:
                 break
             yield item
 
+    def _device_prefetch_depth(self):
+        """Resolved depth of the device-feed stage (0 = off).
+        Precedence: constructor arg > PADDLE_IO_DEVICE_PREFETCH env >
+        auto (2 on non-CPU backends, 0 on CPU)."""
+        n = self.prefetch_to_device
+        if n is None:
+            env = os.environ.get("PADDLE_IO_DEVICE_PREFETCH")
+            if env:
+                try:
+                    n = int(env)
+                except ValueError:
+                    n = None
+        if n is None:
+            try:
+                n = 0 if _is_cpu_backend() else 2
+            except Exception:
+                n = 0
+        return max(0, int(n))
+
+    # bound (seconds) on waiting for the feeder thread when the
+    # consumer abandons a prefetching iterator; a feeder mid-fetch
+    # that outlives it is parked in _pf_orphans and reaped before the
+    # next epoch starts (persistent worker pools can't serve two
+    # epochs at once)
+    _PF_REAP_S = 2.0
+
+    def _reap_orphan_feeders(self):
+        """Join feeder threads abandoned by earlier epochs. Only a
+        PERSISTENT shm worker pool makes the wait semantically
+        required: its run_epoch busy-flag is held until the orphan's
+        in-flight fetch completes and its drain runs, and starting the
+        next epoch before that raises 'already serving an iterator'.
+        Everything else (thread/single-process pipelines, owned pools)
+        has no exclusivity at stake — just prune finished daemons
+        without blocking the training thread."""
+        if not self._pf_orphans:
+            return
+        must_wait = (self.persistent_workers and self.num_workers > 0
+                     and self.use_shared_memory)
+        deadline = _time.monotonic() + (30.0 if must_wait else 0.0)
+        alive = []
+        for t in self._pf_orphans:
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+            if t.is_alive():
+                alive.append(t)
+        self._pf_orphans = alive
+
+    def _device_prefetch_iter(self, depth):
+        """Device-feed stage: a background thread pulls HOST batches
+        from the underlying pipeline, issues the (non-blocking,
+        sharding-aware) device_put, and parks the device-resident
+        batch in a bounded buffer — H2D for batch i+1..i+depth rides
+        under the consumer's compute on batch i. Batch order is the
+        single FIFO queue's order (never reordered, never dropped);
+        abandoning the iterator mid-epoch (break/GC) stops the feeder
+        thread and closes the inner pipeline."""
+        if self.num_workers > 0 and self.use_shared_memory:
+            inner = self._multiprocess_iter(to_device=False)
+        else:
+            # the feed thread already backgrounds the fetch; a second
+            # producer thread (_threaded_iter) would buy nothing
+            inner = self._iter_batches(to_device=False)
+        place = (_device_put_batch if self.collate_fn is None
+                 else (lambda b: b))
+        q = queue.Queue(maxsize=max(1, depth))
+        stop = threading.Event()
+        sentinel = object()
+        failure = []
+
+        def feeder():
+            try:
+                for b in inner:
+                    nb = _host_nbytes(b)
+                    t0 = _time.perf_counter()
+                    d = place(b)
+                    us = int((_time.perf_counter() - t0) * 1e6)
+                    _monitor.stat_add("io/h2d_us", us)
+                    _monitor.stat_add("io/device_prefetch/bytes", nb)
+                    _flight.record("io_h2d", us=us, bytes=nb)
+                    while not stop.is_set():
+                        try:
+                            q.put(d, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                failure.append(e)
+            finally:
+                try:
+                    inner.close()
+                except Exception:
+                    pass
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=feeder, daemon=True,
+                             name="paddle-io-device-feed")
+        _flight.record("io_device_prefetch", phase="start", depth=depth)
+        t.start()
+        try:
+            first = True
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    # the consumer outran the feeder — each stall is a
+                    # step that WAITED on input (the signal the depth
+                    # knob is tuned against). The first get of an
+                    # epoch always finds an empty queue (the feeder
+                    # just started) — counting it would give the
+                    # signal a floor of one stall per epoch that no
+                    # depth could tune away.
+                    if not first:
+                        _monitor.stat_add("io/device_prefetch/stalls",
+                                          1)
+                    item = q.get()
+                first = False
+                _monitor.stat_set("io/device_prefetch/depth", q.qsize())
+                if item is sentinel:
+                    if failure:
+                        raise failure[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            # unblock a feeder parked on q.put, then reap it — with a
+            # BOUND: a feeder mid-fetch (slow __getitem__, blocked
+            # stream) can't observe stop until its item completes, and
+            # abandoning an iterator must not hang the main thread on
+            # it (the daemon thread exits at its next stop check). A
+            # survivor is parked for _reap_orphan_feeders: the next
+            # epoch must wait for it before reusing persistent pools.
+            deadline = _time.monotonic() + self._PF_REAP_S
+            while t.is_alive() and _time.monotonic() < deadline:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+            if t.is_alive():
+                self._pf_orphans.append(t)
+            _flight.record("io_device_prefetch", phase="stop",
+                           reaped=not t.is_alive())
+
     def __iter__(self):
+        self._reap_orphan_feeders()
+        depth = self._device_prefetch_depth()
+        if depth > 0:
+            yield from self._device_prefetch_iter(depth)
+            return
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return

@@ -136,6 +136,13 @@ def _try_push_stacked(ring, samples):
                     # silently broadcast a wrong-shaped one (review);
                     # the generic fallback surfaces the real error
                     return False
+                if src.dtype != layout[i][2]:
+                    # np.stack would PROMOTE mixed dtypes (f32+f64 ->
+                    # f64); copyto(casting="same_kind") into the
+                    # sample-0 layout would instead silently DOWNCAST
+                    # this sample — fall back to the generic
+                    # collate+push path, which promotes like np.stack
+                    return False
                 # [j, ...] keeps a 0-d ndarray view for scalar fields
                 # (plain [j] yields a numpy scalar copyto rejects)
                 np.copyto(views[i][j, ...], src, casting="same_kind")

@@ -183,7 +183,9 @@ def cache_report():
                 out.append({"kind": "train_step",
                             "fn": type(obj._model).__name__,
                             "entries": int(obj._compiled is not None),
-                            "steps": obj._step})
+                            "steps": obj._step,
+                            "steps_per_dispatch":
+                                getattr(obj, "_steps_per_dispatch", 1)})
         except Exception:
             pass  # a half-torn-down object must not break a dump
     out.sort(key=lambda d: (d["kind"], d["fn"]))
@@ -661,11 +663,20 @@ class TrainStepCompiler:
     usage:
         step = TrainStepCompiler(model, opt, loss_fn)
         loss = step(x, y)          # updates model params in place
+
+    steps_per_dispatch=K fuses K train steps into ONE dispatched XLA
+    program (lax.scan carrying the donated params/opt-state): callers
+    pass each batch element with a leading K axis of stacked
+    microbatches and get back the K per-microstep losses. One host
+    round-trip then amortizes over K steps — the whole-training-loop-
+    on-device move of the Julia-to-TPU work, bounded to K so the host
+    keeps its callback/logging cadence.
     """
 
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
                  accumulate_steps=1, amp_level=None, amp_dtype="bfloat16",
-                 amp_custom_white_list=None, amp_custom_black_list=None):
+                 amp_custom_white_list=None, amp_custom_black_list=None,
+                 steps_per_dispatch=1):
         """accumulate_steps > 1 enables gradient merge (reference:
         fleet gradient_merge_optimizer / RecomputeOptimizer micro-batch
         accumulation): grads from k consecutive calls accumulate in a
@@ -675,7 +686,13 @@ class TrainStepCompiler:
         amp_level="O1" wraps the traced forward in amp.auto_cast so
         allow-listed ops run in `amp_dtype` (reference amp_optimizer O1
         cast insertion, contrib/mixed_precision/decorator.py); "O2" is
-        handled outside via amp.decorate on the model."""
+        handled outside via amp.decorate on the model.
+
+        steps_per_dispatch > 1 scans K microbatches through one
+        program; the learning rate is sampled ONCE per dispatch (the
+        same value a sequential loop that doesn't call scheduler.step()
+        between microsteps would see), and rng counters advance per
+        microstep so dropout/random streams match K separate calls."""
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
@@ -685,6 +702,7 @@ class TrainStepCompiler:
         self._amp_white = amp_custom_white_list
         self._amp_black = amp_custom_black_list
         self._accum_steps = max(1, int(accumulate_steps))
+        self._steps_per_dispatch = max(1, int(steps_per_dispatch))
         self._accum_state = None
         self._compiled = None
         self._names = None
@@ -729,7 +747,23 @@ class TrainStepCompiler:
             pvals, self._opt_state, self._accum_state, fvals, bvals,
             avals, lr, rngc).compile()
 
+    def _check_microbatch_axis(self, batch):
+        """steps_per_dispatch=K expects every batch element stacked
+        with a leading K axis — a wrong-shaped batch would otherwise
+        scan garbage microbatches silently."""
+        k = self._steps_per_dispatch
+        if k <= 1:
+            return
+        for i, b in enumerate(batch):
+            shape = np.shape(b._value if isinstance(b, Tensor) else b)
+            if len(shape) < 1 or shape[0] != k:
+                raise ValueError(
+                    f"steps_per_dispatch={k}: batch element {i} must "
+                    f"carry a leading axis of {k} stacked microbatches,"
+                    f" got shape {tuple(shape)}")
+
     def __call__(self, *batch):
+        self._check_microbatch_axis(batch)
         trainable, frozen, bufs = self._params_and_buffers()
         self._prepare_call(trainable, frozen, bufs)
         if self._compiled is None:
@@ -782,9 +816,29 @@ class TrainStepCompiler:
             p._value = new_p[k]
         for k, b in bufs.items():
             b._value = new_b[k]
-        self._step += 1
-        if self._step % self._accum_steps == 0:
-            self._opt._step_count += 1
+        kd = self._steps_per_dispatch
+        # dispatch accounting: ONE host->device program launch just
+        # covered kd train steps — bench reads these to attribute the
+        # amortization win (acceptance: jit/dispatches == steps / K)
+        _monitor.stat_add("jit/dispatches", 1)
+        _monitor.stat_add("jit/steps", kd)
+        if kd > 1:
+            # gauge = width of the last FUSED dispatch; K=1 siblings
+            # (fused-fit tails, ordinary configs in the same process)
+            # must not overwrite it to 1 and erase the attribution —
+            # jit/steps / jit/dispatches carries the exact ratio
+            _monitor.stat_set("jit/steps_per_dispatch", kd)
+            # the common K=1 path already leaves jit_cache_hit events;
+            # only fused dispatches get their own ring entry
+            _flight.record("jit_dispatch", steps=kd)
+        prev = self._step
+        self._step += kd
+        # optimizer step count: how many k-th accumulation boundaries
+        # the kd microsteps crossed (generalizes the old per-call
+        # `step % accum == 0` check)
+        self._opt._step_count += (self._step // self._accum_steps
+                                  - prev // self._accum_steps)
+        # K>1 returns the K per-microstep losses (shape (K,))
         return Tensor(loss, stop_gradient=True, _internal=True)
 
     def _init_opt_state(self, t_items):
@@ -796,6 +850,34 @@ class TrainStepCompiler:
              for k, p in t_items}
             if self._accum_steps > 1 else {})
 
+    def adopt_state_from(self, other):
+        """Take over `other`'s live optimizer/accumulator state and
+        step counter. For two compilers over the SAME model/optimizer
+        but different steps_per_dispatch (hapi's fused dispatch + its
+        K=1 tail step): whichever ran last holds the canonical
+        (possibly donated-and-replaced) arrays, so the next user must
+        adopt before dispatching or it would feed stale — on TPU,
+        already-donated — buffers back into its program."""
+        if other is None or other._opt_state is None:
+            return
+        self._opt_state = other._opt_state
+        if self._accum_steps == getattr(other, "_accum_steps", 1):
+            self._accum_state = other._accum_state
+        elif self._accum_steps > 1:
+            # different merge width: the sibling's partial window
+            # can't continue at this width — start a fresh one
+            # (mirrors _init_opt_state's zeros)
+            self._accum_state = {
+                k: jnp.zeros(p._value.shape, jnp.float32)
+                for k, p in self._model.named_parameters()
+                if p.trainable}
+        else:
+            self._accum_state = {}
+        self._step = other._step
+        for attr in ("_slot_shardings", "_accum_shardings"):
+            if hasattr(other, attr) and getattr(other, attr) is not None:
+                setattr(self, attr, getattr(other, attr))
+
     def _build(self, trainable, frozen, bufs, batch):
         model = self._model
         loss_fn = self._loss_fn
@@ -803,7 +885,8 @@ class TrainStepCompiler:
         t_items = list(trainable.items())
         f_items = list(frozen.items())
         b_items = list(bufs.items())
-        self._init_opt_state(t_items)
+        if self._opt_state is None:  # not adopted from a sibling
+            self._init_opt_state(t_items)
 
         import contextlib
 
@@ -857,9 +940,10 @@ class TrainStepCompiler:
                     _random.pop_traced_key(prev_key)
 
         k_merge = self._accum_steps
+        k_dispatch = self._steps_per_dispatch
 
-        def step_fn(pvals, opt_state, accum, fvals, bvals, avals, lr,
-                    rngc):
+        def one_step(pvals, opt_state, accum, fvals, bvals, avals, lr,
+                     rngc):
             (loss, new_bvals), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(pvals, fvals, bvals, avals, rngc)
             if k_merge <= 1:
@@ -885,6 +969,30 @@ class TrainStepCompiler:
             new_p, new_s, new_acc = jax.lax.cond(do_apply, _apply, _skip,
                                                  None)
             return new_p, new_s, new_acc, new_bvals, loss
+
+        if k_dispatch <= 1:
+            step_fn = one_step
+        else:
+            # fused multi-step dispatch: scan the SAME one_step body
+            # over K stacked microbatches, carrying the donated
+            # (params, opt_state, accum, buffers) entirely on device.
+            # frozen params and lr broadcast (closure); rng counters
+            # advance per microstep so random streams match K
+            # sequential dispatches bit-for-bit.
+            def step_fn(pvals, opt_state, accum, fvals, bvals, avals,
+                        lr, rngc):
+                def body(carry, xs):
+                    p, s, acc, bv = carry
+                    av, rc = xs
+                    p, s, acc, bv, loss = one_step(p, s, acc, fvals,
+                                                   bv, av, lr, rc)
+                    return (p, s, acc, bv), loss
+
+                rcs = rngc + jnp.arange(k_dispatch, dtype=jnp.uint32)
+                (p, s, acc, bv), losses = jax.lax.scan(
+                    body, (pvals, opt_state, accum, bvals),
+                    (avals, rcs))
+                return p, s, acc, bv, losses
 
         self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
                                         batch)

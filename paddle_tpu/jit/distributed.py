@@ -59,14 +59,16 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
     def __init__(self, model, optimizer, loss_fn=None, mesh=None,
                  batch_specs=None, donate=True, accumulate_steps=1,
                  amp_level=None, amp_dtype="bfloat16",
-                 amp_custom_white_list=None, amp_custom_black_list=None):
+                 amp_custom_white_list=None, amp_custom_black_list=None,
+                 steps_per_dispatch=1):
         from ..distributed import mesh as mesh_mod
 
         super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate,
                          accumulate_steps=accumulate_steps,
                          amp_level=amp_level, amp_dtype=amp_dtype,
                          amp_custom_white_list=amp_custom_white_list,
-                         amp_custom_black_list=amp_custom_black_list)
+                         amp_custom_black_list=amp_custom_black_list,
+                         steps_per_dispatch=steps_per_dispatch)
         self._mesh = mesh or mesh_mod.default_mesh()
         mesh_mod.set_mesh(self._mesh)  # activation constraints read this
         self._batch_specs = batch_specs
@@ -80,8 +82,23 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                                          self._mesh))
 
     def _batch_sharding(self, i, ndim):
-        spec = (self._batch_specs[i] if self._batch_specs is not None
-                else P(*(("dp",) + (None,) * (ndim - 1))))
+        """Data sharding for batch element i. With steps_per_dispatch
+        K>1 the element carries a leading K microbatch axis that must
+        stay UNSHARDED (every device runs every microstep of the scan)
+        — the 'dp' shard moves to axis 1, and user batch_specs (which
+        describe ONE microbatch) get a None prepended."""
+        k = self._steps_per_dispatch
+        if self._batch_specs is not None:
+            spec = self._batch_specs[i]
+            if k > 1:
+                # a None entry means "replicated" (filter_spec maps it
+                # to P()) — prepend the unsharded K axis to its empty
+                # spec, not to None itself
+                spec = P(*((None,) + (tuple(spec) if spec is not None
+                                      else ())))
+        else:
+            lead = (None, "dp") if k > 1 else ("dp",)
+            spec = P(*(lead + (None,) * (ndim - len(lead)))[:ndim])
         return NamedSharding(self._mesh, filter_spec(spec, self._mesh))
 
     @staticmethod

@@ -8,11 +8,15 @@ tooling around them):
     stat_set / registry / device_memory_stats ...), populated by the
     instrumented layers: `op/...` (engine dispatch under
     FLAGS_profile_ops), `jit/...` (compile cache hits/misses + wall
-    time, digest-cache evictions), `comm/...` (per-collective
-    calls/bytes/host time), `io/...` (dataloader batches/bytes/ring
-    waits), `step/...` (train-loop metrics via StepTimer), and
-    `analysis/...` (paddle_tpu.analysis: checks run,
-    `analysis/<PTA code>/findings` per diagnostic, hook_errors).
+    time, digest-cache evictions, and the latency-hiding pipeline's
+    `jit/{dispatches,steps,steps_per_dispatch}` — program launches vs
+    train steps covered), `comm/...` (per-collective calls/bytes/host
+    time), `io/...` (dataloader batches/bytes/ring waits, plus the
+    device-feed stage's `io/h2d_us` and
+    `io/device_prefetch/{depth,stalls,bytes}`), `step/...` (train-loop
+    metrics via StepTimer), and `analysis/...` (paddle_tpu.analysis:
+    checks run, `analysis/<PTA code>/findings` per diagnostic,
+    hook_errors).
 
   * StepTimer — per-step training metrics hub: step time, throughput,
     loss, lr and PJRT device-memory high water, written into the

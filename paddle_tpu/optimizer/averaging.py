@@ -49,6 +49,11 @@ class ExponentialMovingAverage:
         self._decay = float(decay)
         self._thres_steps = thres_steps
         self._t = 0
+        # product of the decays ACTUALLY applied: with thres_steps the
+        # per-update decay is scheduled, so the bias correction must
+        # track prod(d_i), not decay**t (which inflated params ~900x
+        # early in scheduled runs — ADVICE high)
+        self._corr_prod = 1.0
         self._shadow = {id(p): jnp.zeros_like(
             p._value, dtype=jnp.float32) for p in self._params}
         self._backup = None
@@ -64,6 +69,7 @@ class ExponentialMovingAverage:
         """Fold the current parameter values into the shadow EMAs."""
         d = self._decay_t()
         self._t += 1
+        self._corr_prod *= d
         for p in self._params:
             s = self._shadow[id(p)]
             self._shadow[id(p)] = d * s + (1.0 - d) * p._value.astype(
@@ -72,7 +78,9 @@ class ExponentialMovingAverage:
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
         """Swap bias-corrected EMAs into the parameters."""
-        corr = 1.0 - self._decay ** max(self._t, 1)
+        corr = 1.0 - self._corr_prod
+        if corr <= 0.0:  # apply() before any update(): nothing folded
+            corr = 1.0 - self._decay ** max(self._t, 1)
         self._backup = {id(p): p._value for p in self._params}
         for p in self._params:
             ema = self._shadow[id(p)] / corr
@@ -94,6 +102,7 @@ class ExponentialMovingAverage:
         return {
             "t": self._t,
             "decay": self._decay,
+            "corr_prod": self._corr_prod,
             "shadow": [np.asarray(self._shadow[id(p)])
                        for p in self._params],
         }
@@ -101,6 +110,10 @@ class ExponentialMovingAverage:
     def set_state_dict(self, state):
         self._t = int(state["t"])
         self._decay = float(state["decay"])
+        # older checkpoints lack corr_prod: decay**t is exact for them
+        # when decay was constant (the only correct case back then)
+        self._corr_prod = float(state.get("corr_prod",
+                                          self._decay ** self._t))
         for p, s in zip(self._params, state["shadow"]):
             self._shadow[id(p)] = jnp.asarray(s, jnp.float32)
 
@@ -190,8 +203,12 @@ class LookAhead(_InnerWrapper):
 class ModelAverage(_InnerWrapper):
     """Accumulate parameter sums each step; apply() swaps the window
     average in (reference sum_1/sum_2/sum_3 tiers collapse to one
-    running sum + count — numerically identical, the tiers exist in
-    the reference only to bound fp32 accumulation error in-graph)."""
+    running sum + count). The collapse matches the reference's window
+    semantics but is NOT bit-identical to it: the tiers bound fp32
+    accumulation error by re-summing in stages, so long windows can
+    differ in low-order float bits from the tiered scheme (the single
+    running fp32 sum accumulates rounding the tiers would have
+    flushed)."""
 
     def __init__(self, average_window_rate, parameters=None,
                  min_average_window=10000, max_average_window=10000,

@@ -65,6 +65,50 @@ def test_ema_thres_steps_schedules_decay():
     assert abs(ema._decay_t() - 0.1) < 1e-9
 
 
+def test_ema_scheduled_decay_bias_correction_exact():
+    """thres_steps schedules the APPLIED decay per update, so the
+    bias correction must be 1 - prod(d_i), not 1 - decay**t — the old
+    decay**t form divided early-scheduled EMAs by ~1/900th of the
+    right correction and inflated applied parameters (ADVICE high)."""
+    model = _mk()
+    steps = iter([0.0, 5.0, 50.0, 1e6, 1e6])
+    ema = optim.ExponentialMovingAverage(model.parameters(), decay=0.999,
+                                         thres_steps=lambda: next(steps))
+    shadows = {id(p): np.zeros_like(np.asarray(p._value), np.float32)
+               for p in model.parameters()}
+    prod = 1.0
+    for t, ts in enumerate([0.0, 5.0, 50.0, 1e6, 1e6]):
+        d = min(0.999, (1.0 + ts) / (10.0 + ts))
+        prod *= d
+        for p in model.parameters():
+            shadows[id(p)] = d * shadows[id(p)] + (1 - d) * np.asarray(
+                p._value, np.float32)
+        ema.update()
+    corr = 1.0 - prod  # ~0.9998 — decay**5 correction would be ~0.005
+    with ema.apply():
+        for p in model.parameters():
+            np.testing.assert_allclose(np.asarray(p._value),
+                                       shadows[id(p)] / corr,
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_ema_state_dict_roundtrip_preserves_corr_prod():
+    model = _mk()
+    ema = optim.ExponentialMovingAverage(model.parameters(), decay=0.9,
+                                         thres_steps=lambda: 0.0)
+    ema.update()  # applied decay 0.1, NOT 0.9
+    sd = ema.state_dict()
+    assert abs(sd["corr_prod"] - 0.1) < 1e-12
+    ema2 = optim.ExponentialMovingAverage(model.parameters(), decay=0.9)
+    ema2.set_state_dict(sd)
+    assert abs(ema2._corr_prod - 0.1) < 1e-12
+    # legacy checkpoint without corr_prod: falls back to decay**t
+    legacy = {k: v for k, v in sd.items() if k != "corr_prod"}
+    ema3 = optim.ExponentialMovingAverage(model.parameters(), decay=0.9)
+    ema3.set_state_dict(legacy)
+    assert abs(ema3._corr_prod - 0.9) < 1e-12
+
+
 def test_ema_state_dict_roundtrip():
     model = _mk()
     ema = optim.ExponentialMovingAverage(model.parameters(), decay=0.9)

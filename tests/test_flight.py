@@ -699,11 +699,16 @@ def test_cli_merge_traces_preserves_input_process_names(tmp_path):
 
 def test_monitor_env_vars_documented_in_readme():
     """CI gate (the test_analysis_selfcheck pattern): every PADDLE_*
-    env var the monitor stack reads must appear in the README env-var
-    table — new knobs can't ship undocumented."""
+    env var the monitor stack — plus the io/jit/hapi performance
+    knobs (PADDLE_IO_DEVICE_PREFETCH, PADDLE_JIT_STEPS_PER_DISPATCH)
+    — reads must appear in the README env-var table — new knobs can't
+    ship undocumented."""
     files = glob.glob(os.path.join(REPO, "paddle_tpu", "monitor*.py"))
     files += glob.glob(
         os.path.join(REPO, "paddle_tpu", "monitor", "*.py"))
+    files += glob.glob(os.path.join(REPO, "paddle_tpu", "io", "*.py"))
+    files += glob.glob(os.path.join(REPO, "paddle_tpu", "jit", "*.py"))
+    files += glob.glob(os.path.join(REPO, "paddle_tpu", "hapi", "*.py"))
     assert files, "monitor sources not found"
     pat = re.compile(r"PADDLE_[A-Z0-9_]+")
     used = set()
