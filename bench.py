@@ -567,6 +567,14 @@ def main():
             if k.startswith("io/device_prefetch/")
             or k in ("io/h2d_us", "jit/dispatches", "jit/steps",
                      "jit/steps_per_dispatch")}
+        # memory trajectory (ISSUE 5): device allocated/peak gauges,
+        # per-program HBM footprints (mem/program/<fn>/*) and the
+        # step-boundary gauges — BENCH_r06+ records track peak-HBM
+        # alongside throughput so a perf win that costs memory
+        # headroom is visible in the same record
+        results["memory"] = {
+            k: v for k, v in results["telemetry"]["stats"].items()
+            if k.startswith(("mem/", "step/mem/"))}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

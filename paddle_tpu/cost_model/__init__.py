@@ -5,35 +5,102 @@ cost estimates used by auto-parallel planning).
 TPU-native design: static costs come from XLA itself —
 `jit(fn).lower().compile().cost_analysis()` exposes the compiler's
 flops/bytes estimates (strictly better than the reference's hand-kept
-per-op GFLOP tables); measured costs time the compiled executable.
-Works on whole callables or on static-graph Programs (replayed)."""
+per-op GFLOP tables) and `memory_analysis()` the HBM byte breakdown;
+measured costs time the compiled executable. Compiled executables
+cache per (fn, arg shapes/dtypes) so a planner interleaving
+static_cost / memory_cost / profile_measure over the same candidate
+compiles it ONCE. Works on whole callables or on static-graph
+Programs (replayed)."""
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 import numpy as np
 import jax
+from jax import tree_util
 
 __all__ = ["CostModel"]
+
+# LRU bounds: the caches strongly pin fn/program AND the compiled XLA
+# executable (that's what makes repeat probes free), so a planner
+# sweeping hundreds of candidates must not grow them without bound
+_CACHE_MAX = 32   # compiled executables, (fn, signature)-keyed
+_PROG_MAX = 8     # replay closures, (program, feed-names)-keyed
+
+
+def _sig_of(args):
+    """Shape/dtype signature of an argument pytree — the cache key
+    leg that makes one compile serve every same-shaped probe."""
+    leaves, treedef = tree_util.tree_flatten(args)
+    sig = []
+    for v in leaves:
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            dt = np.asarray(v).dtype
+        sig.append((tuple(np.shape(v)), str(dt)))
+    return treedef, tuple(sig)
 
 
 class CostModel:
     def __init__(self):
-        self._cache = {}
+        # (id(fn), treedef, shapes/dtypes) -> jax.stages.Compiled;
+        # fn kept alive alongside so id() can't be recycled. LRU,
+        # bounded by _CACHE_MAX.
+        self._cache = OrderedDict()
+        # (id(program), version, feed names) ->
+        # (program, replay fn, params). LRU, bounded by _PROG_MAX.
+        self._prog_fns = OrderedDict()
+
+    def _compiled(self, fn, args):
+        """The compiled executable for (fn, arg signature) — compiled
+        on first use, cached for every later static_cost /
+        memory_cost / profile_measure probe of the same candidate."""
+        treedef, sig = _sig_of(args)
+        key = (id(fn), treedef, sig)
+        ent = self._cache.get(key)
+        if ent is None or ent[0] is not fn:
+            compiled = jax.jit(fn).lower(*args).compile()
+            ent = (fn, compiled)
+            self._cache[key] = ent
+            while len(self._cache) > _CACHE_MAX:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
+        return ent[1]
+
+    def _drop_cached_fn(self, fn):
+        """Purge `fn`'s compiled executables from _cache — called when
+        a replay closure is evicted so its executables go with it."""
+        for ck in [k for k, v in self._cache.items() if v[0] is fn]:
+            del self._cache[ck]
 
     # -- static (compiler) costs ------------------------------------------
     def static_cost(self, fn, *example_args):
         """XLA cost analysis: {'flops': ..., 'bytes accessed': ...}."""
-        compiled = jax.jit(fn).lower(*example_args).compile()
-        ca = compiled.cost_analysis()
+        ca = self._compiled(fn, example_args).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         return dict(ca or {})
 
+    def memory_cost(self, fn, *example_args):
+        """XLA memory analysis of the compiled fn: the
+        argument/output/temp/generated-code byte breakdown plus the
+        peak-usage total — the per-program HBM footprint capacity
+        planning sizes against (monitor/memory.py publishes the same
+        numbers, gauge-backed, for live jit programs; a planner
+        probing dozens of candidates goes through here so the
+        registry isn't spammed)."""
+        from ..monitor.memory import extract_memory_analysis
+
+        return extract_memory_analysis(
+            self._compiled(fn, example_args)) or {}
+
     def profile_measure(self, fn, *example_args, warmup=2, iters=10):
-        """Measured step time of the jitted fn (reference
-        profile_measure): returns seconds/iteration."""
-        jfn = jax.jit(fn)
+        """Measured step time of the compiled fn (reference
+        profile_measure): returns seconds/iteration. Shares the
+        executable static_cost/memory_cost compiled — no re-jit."""
+        jfn = self._compiled(fn, example_args)
         out = None
         for _ in range(warmup):
             out = jfn(*example_args)
@@ -52,23 +119,56 @@ class CostModel:
         from ..static.graph import replay_block
 
         feeds = {n: np.asarray(v) for n, v in feed.items()}
-        feed_vars = {n: program._feeds[n] for n in feeds}
-        t_params = program.all_parameters()
+        # ONE replay closure per (program, feed names), cached like
+        # the compiled executables: a fresh closure per call would
+        # mint a fresh id(fn) cache key every time, so repeated
+        # probes of the same program could never hit the compile
+        # cache and each miss would pin another executable
+        # _version leg like the executor cache (static/__init__.py):
+        # a pass mutating the program must mint a fresh closure and
+        # recompile, not reuse pre-pass costs
+        pkey = (id(program), getattr(program, "_version", 0),
+                tuple(sorted(feeds)))
+        ent = self._prog_fns.get(pkey)
+        if ent is None or ent[0] is not program:
+            # a version bump mints a fresh pkey (and an id-recycled
+            # program a fresh closure), so drop this program's
+            # stale-version entries — and any entry a recycled id
+            # shadows — along with the compiled executables their
+            # closures pinned in _cache: a planner loop alternating
+            # probe / mutating pass would otherwise accumulate
+            # unreachable-by-key executables forever
+            stale = [k for k, v in self._prog_fns.items()
+                     if (v[0] is program and k[1] != pkey[1])
+                     or k == pkey]
+            for k in stale:
+                self._drop_cached_fn(self._prog_fns.pop(k)[1])
+            feed_vars = {n: program._feeds[n] for n in feeds}
+            t_params = program.all_parameters()
 
-        def fn(feed_vals, pvals):
-            env = {}
-            for n, var in feed_vars.items():
-                env[id(var)] = feed_vals[n]
-            for p, v in zip(t_params, pvals):
-                env[id(p)] = v
-            replay_block(program.global_block(), env)
-            outs = []
-            for blk in program.blocks:
-                for op in blk.ops:
-                    for v in op.out_vars:
-                        if id(v) in env:
-                            outs.append(env[id(v)])
-            return outs[-1] if outs else 0.0
+            def fn(feed_vals, pvals):
+                env = {}
+                for n, var in feed_vars.items():
+                    env[id(var)] = feed_vals[n]
+                for p, v in zip(t_params, pvals):
+                    env[id(p)] = v
+                replay_block(program.global_block(), env)
+                outs = []
+                for blk in program.blocks:
+                    for op in blk.ops:
+                        for v in op.out_vars:
+                            if id(v) in env:
+                                outs.append(env[id(v)])
+                return outs[-1] if outs else 0.0
+
+            ent = (program, fn, t_params)
+            self._prog_fns[pkey] = ent
+            while len(self._prog_fns) > _PROG_MAX:
+                self._drop_cached_fn(
+                    self._prog_fns.popitem(last=False)[1][1])
+        else:
+            self._prog_fns.move_to_end(pkey)
+        _, fn, t_params = ent
 
         pvals = [p._value for p in t_params]
         cost = self.static_cost(fn, feeds, pvals)

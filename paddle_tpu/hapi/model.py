@@ -26,6 +26,7 @@ from ..core.tensor import Tensor, to_tensor
 from ..core.engine import no_grad
 from ..io import DataLoader, Dataset
 from ..monitor import flight as _flight
+from ..monitor import memory as _memory
 from . import callbacks as cb_mod
 
 
@@ -394,35 +395,44 @@ class Model:
             pending.clear()
 
         try:
-            for epoch in range(epochs):
-                cbks.on_epoch_begin(epoch)
-                for m in self._metrics:
-                    m.reset()
-                for step, batch in enumerate(loader):
-                    ins, lbls = self._split_batch(batch)
-                    bs = _batch_size_of(ins)
-                    # ONE step path for every K: batches buffer into
-                    # K-sized groups and _flush_pending fires the
-                    # per-batch callback pair — K=1 groups simply
-                    # flush (sequentially) on every batch
-                    pending.append((step, ins, lbls, bs))
-                    if len(pending) >= k_fused:
-                        _flush_pending()
-                        if (num_iters is not None
-                                and iters_done >= num_iters):
-                            break
-                _flush_pending()  # ragged/short tail group
-                cbks.on_epoch_end(epoch, {"loss": loss[0]})
-                if eval_loader is not None \
-                        and (epoch + 1) % eval_freq == 0:
-                    self.evaluate(eval_loader, batch_size=batch_size,
-                                  verbose=0)
-                if save_dir is not None and (epoch + 1) % save_freq == 0:
-                    self.save(f"{save_dir}/epoch_{epoch}")
-                if self.stop_training:
-                    break
-                if num_iters is not None and iters_done >= num_iters:
-                    break
+            # OOM forensics: a RESOURCE_EXHAUSTED anywhere in the
+            # train loop leaves an "oom" bundle whose memory section
+            # holds the live-array census + per-program footprints —
+            # captured HERE, before unwinding releases the arrays
+            # (the excepthook fires too late for that evidence).
+            # PADDLE_FLIGHT_AUTOARM=0 disarms it like the excepthook.
+            with _memory.auto_oom_observer():
+                for epoch in range(epochs):
+                    cbks.on_epoch_begin(epoch)
+                    for m in self._metrics:
+                        m.reset()
+                    for step, batch in enumerate(loader):
+                        ins, lbls = self._split_batch(batch)
+                        bs = _batch_size_of(ins)
+                        # ONE step path for every K: batches buffer
+                        # into K-sized groups and _flush_pending fires
+                        # the per-batch callback pair — K=1 groups
+                        # simply flush (sequentially) on every batch
+                        pending.append((step, ins, lbls, bs))
+                        if len(pending) >= k_fused:
+                            _flush_pending()
+                            if (num_iters is not None
+                                    and iters_done >= num_iters):
+                                break
+                    _flush_pending()  # ragged/short tail group
+                    cbks.on_epoch_end(epoch, {"loss": loss[0]})
+                    if eval_loader is not None \
+                            and (epoch + 1) % eval_freq == 0:
+                        self.evaluate(eval_loader,
+                                      batch_size=batch_size, verbose=0)
+                    if save_dir is not None \
+                            and (epoch + 1) % save_freq == 0:
+                        self.save(f"{save_dir}/epoch_{epoch}")
+                    if self.stop_training:
+                        break
+                    if num_iters is not None \
+                            and iters_done >= num_iters:
+                        break
             cbks.on_end("train")
         finally:
             # fit-scoped accumulation state must not leak: a partial

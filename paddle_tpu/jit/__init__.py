@@ -178,14 +178,21 @@ def cache_report():
                             "fn": obj._telemetry_key,
                             "entries": len(keys),
                             "keys": [repr(k)[:200] for k in
-                                     keys[:_CACHE_REPORT_MAX_KEYS]]})
+                                     keys[:_CACHE_REPORT_MAX_KEYS]],
+                            # per-entry memory_analysis() byte dicts,
+                            # aligned with "keys" (None where capture
+                            # was off/failed) — the HBM-footprint leg
+                            # of an OOM post-mortem
+                            "memory": [obj._mem.get(k) for k in
+                                       keys[:_CACHE_REPORT_MAX_KEYS]]})
             elif isinstance(obj, TrainStepCompiler):
                 out.append({"kind": "train_step",
                             "fn": type(obj._model).__name__,
                             "entries": int(obj._compiled is not None),
                             "steps": obj._step,
                             "steps_per_dispatch":
-                                getattr(obj, "_steps_per_dispatch", 1)})
+                                getattr(obj, "_steps_per_dispatch", 1),
+                            "memory": obj._mem_analysis})
         except Exception:
             pass  # a half-torn-down object must not break a dump
     out.sort(key=lambda d: (d["kind"], d["fn"]))
@@ -227,6 +234,7 @@ class StaticFunction:
         self._needs_tape = _source_calls_grad(func)
         self._input_spec = input_spec
         self._compiled = {}
+        self._mem = {}  # cache key -> memory_analysis() byte dict
         # computed once — __call__ is the per-train-step hot path
         self._telemetry_key = _telemetry_name(func)
         _live_compiled.add(self)
@@ -242,6 +250,7 @@ class StaticFunction:
             if self._trace_target is not self._func else bound._func
         bound._input_spec = self._input_spec
         bound._compiled = self._compiled
+        bound._mem = self._mem  # shared like _compiled: ONE cache
         bound._needs_tape = self._needs_tape
         bound._telemetry_key = self._telemetry_key
         functools.update_wrapper(bound, bound._func,
@@ -330,6 +339,7 @@ class StaticFunction:
         else:
             _monitor.stat_add(f"jit/{fname}/cache_hit", 1)
             _flight.record("jit_cache_hit", fn=fname)
+        call_ok = False
         try:
             jfn, box = entry
             arg_ts = [flat_args[i] for i in tensor_pos]
@@ -353,6 +363,7 @@ class StaticFunction:
                 _random._rng.counter += 1
                 for (buf, _), nv in zip(box["buf_refs"], buf_outs):
                     buf._value = nv._value
+                call_ok = True
                 return tree_util.tree_unflatten(box["treedef"],
                                                 list(outs))
             pvals = [p._value for p in params]
@@ -364,6 +375,7 @@ class StaticFunction:
                 buf._value = nv
             flat_out = [Tensor(v, stop_gradient=True, _internal=True)
                         for v in out_vals]
+            call_ok = True
             return tree_util.tree_unflatten(box["treedef"], flat_out)
         finally:
             if compile_ev is not None:
@@ -372,6 +384,17 @@ class StaticFunction:
                 _monitor.stat_add(
                     f"jit/{fname}/compile_us",
                     int((_time.perf_counter() - t_compile0) * 1e6))
+                # footprint capture only AFTER the first successful
+                # execution: capturing at build time would run the
+                # function's first-ever trace, and a user-code raise
+                # inside a swallowed trace leaks a buffer scope the
+                # real call would otherwise clean up on its way out.
+                # call_ok (not sys.exc_info) — the latter also sees a
+                # CALLER's in-flight handled exception and would skip
+                # capture for a first call made inside an except block
+                if call_ok:
+                    self._capture_memory(key, entry[0], params,
+                                         flat_args, tensor_pos)
 
     def _build(self, target, params, args_treedef, tensor_pos,
                static_leaves, arg_sg=None):
@@ -416,6 +439,58 @@ class StaticFunction:
                     _random.pop_traced_key(prev_key)
 
         return jfn, box
+
+    def _capture_memory(self, key, jfn, params, flat_args, tensor_pos):
+        """Record the fresh cache entry's memory_analysis() byte
+        breakdown (argument/output/temp/generated-code) under
+        mem/program/<fn>/* and in self._mem for cache_report().
+        Lowers via ShapeDtypeStructs — no array materialization; the
+        lowering is shared with the call path, the XLA backend pass
+        is one extra compile, so PADDLE_MEM_PROGRAM=0 opts out."""
+        from ..monitor import memory as _memory
+
+        if not _memory.program_capture_enabled():
+            return
+        try:
+            p_structs = [jax.ShapeDtypeStruct(p._value.shape,
+                                              p._value.dtype)
+                         for p in params]
+            a_structs = [jax.ShapeDtypeStruct(flat_args[i]._value.shape,
+                                              flat_args[i]._value.dtype)
+                         for i in tensor_pos]
+            rng = jax.ShapeDtypeStruct((), jnp.uint32)
+            # the capture's extra backend compile can stall as long as
+            # the real one — span it so the watchdog's in-flight table
+            # and jit/<fn>/mem_capture_us attribute the time instead
+            # of leaving an unexplained first-call gap
+            t0 = _time.perf_counter()
+            with _flight.in_flight("mem_capture",
+                                   self._telemetry_key):
+                compiled = jfn.lower(p_structs, a_structs,
+                                     rng).compile()
+            _monitor.stat_add(
+                f"jit/{self._telemetry_key}/mem_capture_us",
+                int((_time.perf_counter() - t0) * 1e6))
+            # shape-specialized cache entries of one fn must not share
+            # a gauge name — the tail-batch entry would overwrite the
+            # full-batch footprint (last-writer-wins); entry 0 keeps
+            # the plain name, later entries get an ordinal suffix.
+            # The ordinal is the entry's position in _compiled — the
+            # same index program_footprints() derives bundle names
+            # from — NOT len(_mem): a first-call failure leaves no
+            # _mem entry, and a length-based ordinal would then let
+            # gauge and bundle names drift out of lockstep
+            try:
+                ordinal = list(self._compiled).index(key)
+            except ValueError:
+                ordinal = len(self._mem)
+            name = (self._telemetry_key if ordinal == 0
+                    else f"{self._telemetry_key}#{ordinal}")
+            self._mem[key] = _memory.record_program_memory(
+                name, compiled)
+        except Exception:
+            # footprint capture is observability, never a build error
+            self._mem[key] = None
 
     def concrete_program(self):
         return None
@@ -708,6 +783,7 @@ class TrainStepCompiler:
         self._names = None
         self._opt_state = None
         self._step = 0
+        self._mem_analysis = None  # memory_analysis() byte dict
         _live_compiled.add(self)
 
     def _params_and_buffers(self):
@@ -794,10 +870,57 @@ class TrainStepCompiler:
             _monitor.stat_add(
                 "jit/train_step/compile_us",
                 int((_time.perf_counter() - t0) * 1e6))
+            self._capture_memory(batch)
             return out
         _monitor.stat_add("jit/train_step/cache_hit", 1)
         _flight.record("jit_cache_hit", fn="train_step")
         return self._run_compiled(trainable, frozen, bufs, batch)
+
+    def _capture_memory(self, batch):
+        """Record the freshly compiled step's memory_analysis()
+        (argument/output/temp/generated-code bytes) in
+        self._mem_analysis (cache_report()'s "memory" field) and the
+        mem/program/train_step:<Model>/* gauges — the per-program HBM
+        footprint an OOM bundle names. Reuses lower_compiled(), so
+        the lowering is shared with the call path and the cost is
+        one extra XLA backend compile; PADDLE_MEM_PROGRAM=0 opts
+        out. Never raises: footprints are observability."""
+        from ..monitor import memory as _memory
+
+        if not _memory.program_capture_enabled():
+            return
+        try:
+            # the gauge name carries the model class (compilers over
+            # different model CLASSES must not share one gauge — the
+            # last one compiled would overwrite the others'
+            # footprints) and the dispatch width K (Model.fit's fused
+            # K-step program and its K=1 tail sibling are live
+            # together; the tail compiles last and would overwrite
+            # the fused footprint with a ~K-times-smaller one). Two
+            # instances of the SAME class at the same K still share a
+            # gauge (last writer wins) — deliberate: per-instance
+            # names would grow the persistent registry unboundedly
+            # across a sweep's recompiles, and the bundle path
+            # (program_footprints) keeps every live footprint via
+            # its "(n)" suffixing, so dumps never lose one
+            k = getattr(self, "_steps_per_dispatch", 1)
+            name = f"train_step:{type(self._model).__name__}"
+            if k != 1:
+                name += f"@k{k}"
+            # span the capture's extra backend compile — it runs after
+            # the "compile" span closed, and a multi-minute capture
+            # must show in the watchdog's in-flight table, not as an
+            # unattributed first-step stall
+            t0 = _time.perf_counter()
+            with _flight.in_flight("mem_capture", name):
+                compiled = self.lower_compiled(*batch)
+            _monitor.stat_add(
+                "jit/train_step/mem_capture_us",
+                int((_time.perf_counter() - t0) * 1e6))
+            self._mem_analysis = _memory.record_program_memory(
+                name, compiled)
+        except Exception:
+            self._mem_analysis = None
 
     def _run_compiled(self, trainable, frozen, bufs, batch):
         pvals = {k: p._value for k, p in trainable.items()}

@@ -51,22 +51,33 @@ from ..core.monitor import (  # noqa: F401 — the counter surface
     device_memory_in_use,
 )
 from . import flight  # noqa: E402 — the failure-forensics leg
+from . import memory  # noqa: E402 — the device-memory leg
 
 __all__ = [
     "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
     "stat_set", "stat_reset", "VLOG", "vlog_level",
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
-    "get_exporter", "telemetry_snapshot", "flight",
+    "get_exporter", "telemetry_snapshot", "flight", "memory",
 ]
 
 
 def telemetry_snapshot():
     """Timestamped copy of the full StatRegistry — the record the
     exporter flushes and bench.py embeds in its `extra` field. Syncs
-    the flight ring's amortized counters first so flight/... gauges
-    are exact in every flush/dump."""
+    the flight ring's amortized counters and the device-memory
+    gauges (mem/{allocated,peak}_bytes) first so both are exact in
+    every flush/dump."""
     flight.sync_stats()
+    try:
+        # guard like flight's own evidence gathering: a snapshot
+        # taken from a crash/watchdog dump path must neither break
+        # on nor INITIALIZE a backend mid-rendezvous (jax.devices()
+        # blocks rather than raises there)
+        if flight._jax_backends_live():
+            memory.sync_gauges()
+    except Exception:
+        pass
     return {"ts": round(time.time(), 3), "rank": _rank(),
             "stats": registry.snapshot()}
 
@@ -97,6 +108,7 @@ class StepTimer:
         self._window = int(window)
         self._times = []     # recent step durations (seconds)
         self._last = {}
+        self._mem_prev = None  # allocated bytes at last step boundary
 
     def begin_step(self):
         self._t0 = time.perf_counter()
@@ -127,8 +139,20 @@ class StepTimer:
             stat_set("step/last_loss_e6", int(float(loss) * 1e6))
         if lr is not None:
             stat_set("step/lr_e9", int(float(lr) * 1e9))
-        used, peak = device_memory_in_use()
+        # step-boundary memory tracking (PADDLE_MEM_STEP=0 disables —
+        # on backends without PJRT stats each reading is a live-array
+        # census walk): allocated/peak gauges under step/mem/*, the
+        # signed per-step delta, and — while a Profiler captures —
+        # mem/{allocated,peak}_bytes counter (ph "C") samples so the
+        # merged chrome trace shows a memory timeline next to spans
+        used, peak = memory.step_reading()
         if used or peak:
+            stat_set("step/mem/allocated_bytes", used)
+            registry.get("step/mem/peak_bytes").maximum(peak)
+            if self._mem_prev is not None:
+                stat_set("step/mem/delta_bytes", used - self._mem_prev)
+            self._mem_prev = used
+            # legacy names (pre-memory-module consumers)
             stat_set("step/device_mem_bytes_in_use", used)
             registry.get("step/device_mem_peak_bytes").maximum(peak)
 
@@ -143,6 +167,9 @@ class StepTimer:
             if lr is not None:
                 _prof.record_counter("lr", float(lr), ts=now)
             if used or peak:
+                _prof.record_counter("mem/allocated_bytes", used,
+                                     ts=now)
+                _prof.record_counter("mem/peak_bytes", peak, ts=now)
                 _prof.record_counter("device_mem_bytes_in_use", used,
                                      ts=now)
         self._last = {"time_s": dt, "batch_size": batch_size,

@@ -15,6 +15,13 @@ artifacts the runtime leaves behind:
   tail <metrics.jsonl> [--keys p1,p2] [--all]
       Summarize a monitor.MetricsExporter JSON-lines trail: flush
       cadence per rank + the latest snapshot's interesting stats.
+
+  memory [--json] [--top K]
+      THIS process's memory report: device stats (PJRT or census),
+      per-program HBM footprints off the live jit caches, and the
+      live-array census grouped by shape/dtype. Mostly useful
+      in-process (cli.main(["memory"]) from a REPL/debug hook) —
+      a fresh CLI process has no arrays of its own.
 """
 from __future__ import annotations
 
@@ -121,9 +128,103 @@ def cmd_inspect(args):
         out.append("")
         out.append("jit program caches:")
         for c in caches:
-            out.append(f"  {c.get('kind')}:{c.get('fn')}  "
-                       f"entries={c.get('entries')}")
+            line = (f"  {c.get('kind')}:{c.get('fn')}  "
+                    f"entries={c.get('entries')}")
+            m = c.get("memory")
+            note = ""
+            if isinstance(m, list):  # to_static: per-entry dicts
+                dicts = [d for d in m if d]
+                # show the LARGEST entry (the one an OOM cares
+                # about), flagged when other entries exist
+                m = max(dicts,
+                        key=lambda d: d.get("total_bytes", 0),
+                        default=None)
+                if len(dicts) > 1:
+                    note = f" (largest of {len(dicts)} entries)"
+            if isinstance(m, dict):
+                line += ("  mem arg={} temp={} out={}{}".format(
+                    _fmt_bytes(m.get("argument_bytes")),
+                    _fmt_bytes(m.get("temp_bytes")),
+                    _fmt_bytes(m.get("output_bytes")), note))
+            out.append(line)
+    # memory section (absent in pre-PR5 paddle_tpu.flight/1 bundles —
+    # tolerated: the schema only ADDED the key)
+    mem = bundle.get("memory")
+    if isinstance(mem, dict) and not mem.get("uninitialized"):
+        out.append("")
+        out.extend(_memory_lines(mem))
     print("\n".join(out))
+    return 0
+
+
+def _fmt_bytes(n):
+    """Human bytes: the census/report tables print 1.5GiB, not
+    1610612736."""
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return (f"{int(n)}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+
+
+def _memory_lines(mem):
+    """Render a memory report/section dict (bundle `memory` key or
+    monitor.memory.memory_report()) as indented text lines."""
+    out = []
+    if mem.get("error"):
+        return [f"memory: unavailable ({mem['error']})"]
+    dev = mem.get("device") or {}
+    out.append(f"memory ({dev.get('source', '?')}): "
+               f"allocated {_fmt_bytes(dev.get('allocated_bytes'))}, "
+               f"peak {_fmt_bytes(dev.get('peak_bytes'))}")
+    progs = mem.get("programs") or {}
+    if progs:
+        out.append("  program footprints:")
+        for name in sorted(
+                progs, key=lambda n: -(progs[n] or {}).get(
+                    "total_bytes", 0)):
+            p = progs[name] or {}
+            out.append(
+                f"    {name}: total {_fmt_bytes(p.get('total_bytes'))}"
+                f"  (arg {_fmt_bytes(p.get('argument_bytes'))}, "
+                f"temp {_fmt_bytes(p.get('temp_bytes'))}, "
+                f"out {_fmt_bytes(p.get('output_bytes'))}, "
+                f"code {_fmt_bytes(p.get('generated_code_bytes'))})")
+    census = mem.get("census")
+    if isinstance(census, dict):
+        shown = census.get("groups") or []
+        out.append(
+            f"  live arrays: {census.get('total_arrays')} arrays, "
+            f"{_fmt_bytes(census.get('total_bytes'))} in "
+            f"{census.get('group_count')} shape/dtype groups"
+            + (f" (top {len(shown)} shown)"
+               if census.get("truncated") else ""))
+        for g in shown:
+            shape = "x".join(str(d) for d in g.get("shape") or []) \
+                or "scalar"
+            out.append(f"    {_fmt_bytes(g.get('bytes')):>10s}  "
+                       f"{g.get('count'):>5d} x {shape} "
+                       f"{g.get('dtype')}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# memory (live, this-process report)
+# ---------------------------------------------------------------------------
+
+def cmd_memory(args):
+    from . import memory as mem_mod
+
+    report = mem_mod.memory_report(args.top)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+        return 0
+    print("\n".join(_memory_lines(report)))
     return 0
 
 
@@ -288,7 +389,7 @@ def main(argv=None):
         prog="python -m paddle_tpu.monitor",
         description="Failure-forensics CLI: inspect flight dump "
                     "bundles, merge per-rank chrome traces, summarize "
-                    "exporter metrics trails.")
+                    "exporter metrics trails, report live memory.")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pi = sub.add_parser(
@@ -322,6 +423,17 @@ def main(argv=None):
     pt.add_argument("--all", action="store_true",
                     help="show every stat in the latest snapshot")
     pt.set_defaults(fn=cmd_tail)
+
+    pmem = sub.add_parser(
+        "memory",
+        help="live memory report for THIS process: device stats, "
+             "program footprints, live-array census")
+    pmem.add_argument("--json", action="store_true",
+                      help="emit the raw report JSON")
+    pmem.add_argument("--top", type=int, default=None,
+                      help="census groups to show "
+                           "(default PADDLE_MEM_CENSUS_TOP_K)")
+    pmem.set_defaults(fn=cmd_memory)
 
     args = p.parse_args(argv)
     try:

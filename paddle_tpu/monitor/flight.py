@@ -365,7 +365,30 @@ def _jit_cache_info():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def write_dump(reason, extra=None, path=None):
+def _memory_section(reason, full=None, jit_report=None):
+    """Memory evidence for a dump bundle: device stats + per-program
+    footprints in EVERY bundle (cheap reads); the live-array census
+    joins for OOM and operator-requested (sigusr1) dumps, where "what
+    is holding HBM" is the question being asked — `full` overrides
+    the reason-based default either way. `jit_report` reuses the
+    cache_report() the bundle already computed for its jit_caches
+    key instead of walking the live compilers a second time.
+    Evidence gathering must not initialize a backend mid-rendezvous
+    (see _device_info)."""
+    if not _jax_backends_live():
+        return {"uninitialized": True}
+    try:
+        from . import memory as _memory
+
+        if full is None:
+            full = reason in ("oom", "sigusr1")
+        return _memory.memory_section(census=full,
+                                      jit_report=jit_report)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def write_dump(reason, extra=None, path=None, full_memory=None):
     """Write one self-contained JSON forensics bundle and return its
     path. Schema (DUMP_SCHEMA = "paddle_tpu.flight/1"):
 
@@ -377,6 +400,10 @@ def write_dump(reason, extra=None, path=None):
         flight_tail  — newest PADDLE_FLIGHT_DUMP_EVENTS ring events
         telemetry    — monitor.telemetry_snapshot() (full registry)
         jit_caches   — per-function compiled-program cache keys
+        memory       — device stats + per-program footprints (+ the
+          live-array census for oom/sigusr1 reasons; `full_memory`
+          forces it on/off for custom reasons — oom_observer passes
+          True so a renamed OOM bundle keeps its census)
         + reason-specific keys from `extra` (e.g. "exception",
           "stuck")
 
@@ -384,6 +411,7 @@ def write_dump(reason, extra=None, path=None):
     <reason>_rank<r>_pid<p>_<n>.json (atomic tmp+rename), counted
     under flight/dumps_written, echoed at VLOG(0)."""
     ts = time.time()
+    caches = _jit_cache_info()
     payload = {
         "schema": DUMP_SCHEMA,
         "reason": reason,
@@ -399,7 +427,10 @@ def write_dump(reason, extra=None, path=None):
         "threads": _thread_stacks(),
         "flight_tail": recorder.tail(
             _env_int("PADDLE_FLIGHT_DUMP_EVENTS", 256)),
-        "jit_caches": _jit_cache_info(),
+        "jit_caches": caches,
+        "memory": _memory_section(
+            reason, full=full_memory,
+            jit_report=caches if isinstance(caches, list) else None),
     }
     try:
         from . import telemetry_snapshot
@@ -584,12 +615,28 @@ def _format_exception(etype, value, tb):
 
 
 def _crash_dump(etype, value, tb):
+    # memory.oom_observer may already have bundled THIS exception
+    # (with the census taken while the offending arrays were still
+    # live) — the excepthook must not shadow it with a second dump
+    if getattr(value, "_paddle_flight_dumped", False):
+        return None
     recorder.record("exception",
                     type=getattr(etype, "__name__", str(etype)),
                     message=str(value)[:300])
+    reason = "crash"
+    try:
+        from . import memory as _memory
+
+        if _memory.is_oom_error(value):
+            # RESOURCE_EXHAUSTED gets its own reason (an operator
+            # greps for oom_rank*.json) and the full census in its
+            # memory section (_memory_section keys off the reason)
+            reason = "oom"
+    except Exception:
+        pass
     return write_dump(
-        "crash", extra={"exception": _format_exception(etype, value,
-                                                       tb)})
+        reason, extra={"exception": _format_exception(etype, value,
+                                                      tb)})
 
 
 _orig_excepthook = None

@@ -260,10 +260,23 @@ class Profiler:
             if num_samples:
                 _recorder.record_counter("profiler/throughput",
                                          num_samples / dt, ts=now)
-            from ..core.monitor import device_memory_in_use
+            try:
+                from ..monitor import memory as _mem_mod
 
-            used, peak = device_memory_in_use()
+                # PJRT stats where available, live-array census
+                # elsewhere (the CPU client) — so every backend gets
+                # a memory track, not just TPU. PADDLE_MEM_STEP=0
+                # disables here too (same knob as StepTimer: the
+                # census walk is the cost being opted out of).
+                used, peak = _mem_mod.step_reading()
+            except Exception:
+                used = peak = 0
             if used or peak:
+                _recorder.record_counter(
+                    "mem/allocated_bytes", used, ts=now)
+                _recorder.record_counter(
+                    "mem/peak_bytes", peak, ts=now)
+                # legacy series names (pre-memory-module dashboards)
                 _recorder.record_counter(
                     "profiler/device_mem_bytes_in_use", used, ts=now)
                 _recorder.record_counter(

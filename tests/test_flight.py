@@ -701,14 +701,17 @@ def test_monitor_env_vars_documented_in_readme():
     """CI gate (the test_analysis_selfcheck pattern): every PADDLE_*
     env var the monitor stack — plus the io/jit/hapi performance
     knobs (PADDLE_IO_DEVICE_PREFETCH, PADDLE_JIT_STEPS_PER_DISPATCH)
-    — reads must appear in the README env-var table — new knobs can't
-    ship undocumented."""
+    and the device/memory surface (monitor/memory.py,
+    device/__init__.py: PADDLE_MEM_*) — reads must appear in the
+    README env-var table — new knobs can't ship undocumented."""
     files = glob.glob(os.path.join(REPO, "paddle_tpu", "monitor*.py"))
     files += glob.glob(
         os.path.join(REPO, "paddle_tpu", "monitor", "*.py"))
     files += glob.glob(os.path.join(REPO, "paddle_tpu", "io", "*.py"))
     files += glob.glob(os.path.join(REPO, "paddle_tpu", "jit", "*.py"))
     files += glob.glob(os.path.join(REPO, "paddle_tpu", "hapi", "*.py"))
+    files += glob.glob(
+        os.path.join(REPO, "paddle_tpu", "device", "*.py"))
     assert files, "monitor sources not found"
     pat = re.compile(r"PADDLE_[A-Z0-9_]+")
     used = set()
