@@ -222,6 +222,8 @@ class ElasticManager:
         self._stop = threading.Event()
         self._hb_thread = None
         self._last_world = None
+        self._ckpt_mgr = None        # incubate.checkpoint.elastic
+        self._last_ckpt_world = None  # membership at last scale save
         self.enable = self.np_max > self.np_min or elastic_level > 0
 
     # -- membership -------------------------------------------------------
@@ -280,18 +282,53 @@ class ElasticManager:
             f"elastic: only {self.world_size()} of {want} nodes joined "
             f"within {timeout}s")
 
+    def attach_checkpoint_manager(self, mgr):
+        """Wire an incubate.checkpoint.elastic.CheckpointManager in:
+        the first health() poll that sees a membership change (node
+        died / joined — the run is about to be relaunched on a
+        DIFFERENT world) writes a best-effort emergency snapshot, so
+        the reshaped relaunch resumes from the last completed step
+        instead of the last cadence-based save."""
+        self._ckpt_mgr = mgr
+        self._last_ckpt_world = (tuple(self._last_world)
+                                 if self._last_world is not None
+                                 else None)
+
     def health(self):
         """HOLD while the world is wrong; RESTART when a scale event
         settled inside [np_min, np_max]; ERROR below np_min after a
         loss; COMPLETED is the trainer's business."""
         n = self.world_size()
         if self.need_restart():
+            self._scale_checkpoint()
             return ElasticStatus.RESTART
         if n < self.np_min:
+            self._scale_checkpoint()
             return (ElasticStatus.HOLD if self.elastic_level >= 1
                     else ElasticStatus.ERROR)
-        return ElasticStatus.HOLD if self.need_scale() \
-            else ElasticStatus.COMPLETED
+        if self.need_scale():
+            self._scale_checkpoint()
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    def _scale_checkpoint(self):
+        """One emergency snapshot per distinct membership change."""
+        if self._ckpt_mgr is None:
+            return
+        cur = tuple(self.hosts())
+        if cur == self._last_ckpt_world:
+            return
+        self._last_ckpt_world = cur
+        try:
+            # use_provider=False: health() polls run on supervision
+            # threads concurrently with live dispatches — a fresh
+            # device capture here would race donated-buffer frees;
+            # the last already-hostified boundary capture is safe
+            # (and None just means the newest one is already on disk)
+            self._ckpt_mgr.emergency_save("elastic_scale",
+                                          use_provider=False)
+        except Exception:
+            pass  # best-effort: the cadence snapshot still exists
 
     def exit(self):
         self._stop.set()

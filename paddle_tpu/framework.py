@@ -59,13 +59,40 @@ def _from_saved(obj, return_numpy=False):
     return obj
 
 
+def _atomic_write(path, write_fn):
+    """Crash-safe publish: `write_fn(f)` writes into a same-directory
+    tmp file, which is fsync'd and then os.replace()d over `path` —
+    a crash (or kill -9) mid-write leaves either the old complete
+    file or the new complete one, never a torn one. Shared by
+    paddle.save and the elastic checkpoint writer."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave the partial tmp behind (it would look like a
+        # stray checkpoint to directory scanners)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save(obj, path, protocol=4, **configs):
-    """paddle.save — state_dicts / nested containers of Tensors."""
+    """paddle.save — state_dicts / nested containers of Tensors.
+
+    Atomic at EVERY call site (tmp + fsync + os.replace): the elastic
+    restore path depends on this — a torn .pd would burn one snapshot
+    of fallback depth for no reason."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    _atomic_write(path, lambda f: pickle.dump(
+        _to_saveable(obj), f, protocol=protocol))
 
 
 def load(path, return_numpy=False, **configs):

@@ -2,6 +2,7 @@
 ModelCheckpoint, LRScheduler, EarlyStopping, VisualDL)."""
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -84,18 +85,167 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Periodic checkpoints during fit.
+
+    Epoch snapshots ROTATE like the EDL checker: only the newest
+    `max_checkpoint_num` epoch prefixes are kept (default
+    PADDLE_EDL_MAX_CHECKPOINT_NUM, else 5; <= 0 keeps everything) —
+    a month-long fit no longer accumulates one dir per epoch forever.
+    Writes go through framework.save's atomic tmp+fsync+rename, so a
+    crash mid-save never leaves a torn .pdparams.
+
+    training_state=True upgrades the callback to FULL elastic
+    training-state snapshots (incubate.checkpoint.elastic): model +
+    live optimizer slots + rng + LR schedule + step cursor, written
+    asynchronously by the manager's background writer — per
+    `save_steps` steps (default: the manager's
+    PADDLE_CKPT_SAVE_STEPS / time-interval cadence) and at every
+    `save_freq`-th epoch end. Model.fit(resume=...) installs one
+    automatically; it reuses fit's manager (model._ckpt_manager) or
+    builds one over `save_dir`/training_state (else the EDL env
+    contract). It also watches the manager's preemption flag: on
+    SIGTERM the current boundary is checkpointed synchronously and
+    the fit stops."""
+
+    def __init__(self, save_freq=1, save_dir=None,
+                 max_checkpoint_num=None, training_state=False,
+                 save_steps=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        if max_checkpoint_num is None:
+            from ..monitor.flight import _env_int
+
+            max_checkpoint_num = _env_int(
+                "PADDLE_EDL_MAX_CHECKPOINT_NUM", 5)
+        self.max_checkpoint_num = int(max_checkpoint_num)
+        self.training_state = training_state
+        self.save_steps = save_steps
+        self._mgr = None
+        self._owns_mgr = False  # this callback built the manager
+        self._epoch = 0
+        self._step_in_epoch = 0
+
+    # -- elastic manager resolution ----------------------------------
+    def _manager(self):
+        # the model's manager is authoritative: a later fit(resume=)
+        # may have swapped it — a stale cached manager would never
+        # see that fit's preemption flag or feed its state provider
+        live = getattr(self.model, "_ckpt_manager", None)
+        if live is not None:
+            if self.save_steps is not None and live is not self._mgr:
+                live.save_steps = max(0, int(self.save_steps))
+            self._mgr = live
+            return live
+        if self._mgr is None:
+            from ..incubate.checkpoint import elastic as _elastic
+
+            d = (os.path.join(self.save_dir, "training_state")
+                 if self.save_dir else None)
+            self._mgr = _elastic.CheckpointManager(
+                dir=d, save_steps=self.save_steps)
+            self._owns_mgr = True
+            self.model._ckpt_manager = self._mgr
+        return self._mgr
+
+    def _cursor(self, mgr):
+        return {"epoch": self._epoch,
+                "step_in_epoch": self._step_in_epoch,
+                "global_step": mgr.global_step}
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step_in_epoch = 0
+        if self.training_state:
+            mgr = self._manager()
+            cur = mgr.cursor
+            # resumed mid-epoch: the fast-forwarded batches count.
+            # Only when the cursor describes THIS boundary (epoch AND
+            # global step) — a manager kept across fits would
+            # otherwise replay a stale restore cursor into later
+            # fits' snapshots, making resume skip untrained batches.
+            if (cur and int(cur.get("epoch", -1)) == epoch
+                    and int(cur.get("global_step", -1))
+                    == mgr.global_step):
+                self._step_in_epoch = int(cur.get("step_in_epoch", 0))
+
+    def on_train_batch_end(self, step, logs=None):
+        if not self.training_state:
+            return
+        mgr = self._manager()
+        self._step_in_epoch += 1
+        mgr.global_step += 1
+        # refresh the emergency-capture hook every boundary so a
+        # watchdog fire snapshots THIS completed step, not a stale one
+        cur = self._cursor(mgr)
+        mgr.set_state_provider(
+            lambda c=cur: (self.model._training_state(), c))
+        if mgr.preempted.is_set():
+            # preemption: ONE synchronous boundary checkpoint, then
+            # stop. An already-dispatched fused group still fires
+            # K-1 more microstep callbacks — don't burn the SIGTERM
+            # grace window re-snapshotting each of them
+            if not self.model.stop_training:
+                mgr.save(self.model._training_state(), sync=True,
+                         **cur)
+                self.model.stop_training = True
+            return
+        mgr.maybe_save(self.model._training_state,
+                       **cur)
 
     def on_epoch_end(self, epoch, logs=None):
+        live = getattr(self.model, "_ckpt_manager", None)
+        if live is not None and live.preempted.is_set():
+            # the preemption break leaves this epoch INCOMPLETE — an
+            # {epoch}.pdparams of a half-trained epoch would look
+            # like (and via rotation could displace) a real one; the
+            # boundary training-state snapshot was already written
+            # synchronously by on_train_batch_end
+            return
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/{epoch}")
+            self._rotate_epochs()
+        if self.training_state and (epoch + 1) % self.save_freq == 0:
+            mgr = self._manager()
+            # skip when the step-cadence save already captured this
+            # exact boundary (save_steps dividing the epoch length
+            # would otherwise re-hostify + rewrite the same step)
+            if mgr.last_captured_step() < mgr.global_step:
+                mgr.save(self.model._training_state(),
+                         **self._cursor(mgr))
 
     def on_train_end(self, logs=None):
         if self.save_dir:
             self.model.save(f"{self.save_dir}/final")
+        if self.training_state and self._mgr is not None:
+            if self._owns_mgr:
+                # no fit(resume=) finally-block will close this
+                # manager — do it here, or its writer thread and the
+                # model-sized _last host capture outlive the fit
+                self._mgr.close()
+            else:
+                self._mgr.flush()
+
+    def _rotate_epochs(self):
+        """Keep the newest max_checkpoint_num epoch snapshots
+        (numeric prefixes only — 'final' and foreign files stay)."""
+        if self.max_checkpoint_num <= 0 or not self.save_dir:
+            return
+        try:
+            epochs = sorted(
+                int(f[:-len(".pdparams")])
+                for f in os.listdir(self.save_dir)
+                if f.endswith(".pdparams")
+                and f[:-len(".pdparams")].isdigit())
+        except OSError:
+            return
+        for e in epochs[:-self.max_checkpoint_num]:
+            for suffix in (".pdparams", ".pdopt"):
+                try:
+                    os.remove(os.path.join(self.save_dir,
+                                           f"{e}{suffix}"))
+                except OSError:
+                    pass
 
 
 class LRScheduler(Callback):

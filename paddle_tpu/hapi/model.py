@@ -56,6 +56,9 @@ class Model:
         self._fit_accum = 1     # fit(accumulate_grad_batches=...)
         self._accum_seen = 0    # dygraph-fallback accumulation counter
         self._fused_disabled = False  # a fused dispatch failed: latch
+        self._ckpt_manager = None   # elastic CheckpointManager (fit)
+        self._pending_opt_restore = None  # checkpointed opt state the
+        # next fresh compiler preloads (restore_state)
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -83,17 +86,27 @@ class Model:
         if mesh is not None and mesh.size > 1:
             from ..jit.distributed import DistributedTrainStepCompiler
 
-            return self._adopt_stale(DistributedTrainStepCompiler(
+            comp = DistributedTrainStepCompiler(
                 self.network, self._optimizer, loss_fn, mesh=mesh,
                 steps_per_dispatch=steps_per_dispatch,
-                accumulate_steps=self._fit_accum))
-        from ..jit import TrainStepCompiler
+                accumulate_steps=self._fit_accum)
+        else:
+            from ..jit import TrainStepCompiler
 
-        comp = TrainStepCompiler(
-            self.network, self._optimizer, loss_fn,
-            steps_per_dispatch=steps_per_dispatch,
-            accumulate_steps=self._fit_accum)
-        return self._adopt_stale(comp)
+            comp = TrainStepCompiler(
+                self.network, self._optimizer, loss_fn,
+                steps_per_dispatch=steps_per_dispatch,
+                accumulate_steps=self._fit_accum)
+        comp = self._adopt_stale(comp)
+        pend = self._pending_opt_restore
+        if pend is not None and comp._opt_state is None:
+            # elastic resume: a fresh compiler (no live sibling state
+            # to adopt) preloads the checkpointed optimizer slots +
+            # step counter; materialized with this compiler's own
+            # shardings at first build, so a reshaped mesh re-shards
+            comp.restore_state(pend["slots"], pend["step"],
+                               pend.get("accum"))
+        return comp
 
     def _adopt_stale(self, comp):
         """A retired compiler (e.g. stashed at the end of an
@@ -303,7 +316,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            steps_per_dispatch=None):
+            steps_per_dispatch=None, resume=None):
         """steps_per_dispatch=K>1 buffers K loader batches and runs
         them as ONE fused compiled dispatch (jit.TrainStepCompiler's
         lax.scan path) — per-batch callbacks still fire once per
@@ -314,7 +327,21 @@ class Model:
         accumulate_grad_batches=A averages gradients over A batches
         per optimizer step (TrainStepCompiler's gradient merge on the
         compiled path; deferred step + grad averaging on the dygraph
-        fallback). Composes with steps_per_dispatch."""
+        fallback). Composes with steps_per_dispatch.
+
+        resume=True/"auto" (or a checkpoint directory path) turns on
+        ELASTIC fault tolerance: the newest valid training-state
+        snapshot under the EDL env contract
+        (<PADDLE_CKPT_DIR|PADDLE_CHECKPOINT_DIR>/<PADDLE_JOB_ID>) is
+        restored — model, optimizer slots, rng, LR schedule,
+        epoch/step cursor, sampler fast-forward — and training
+        continues BIT-IDENTICALLY from the interruption point; the fit
+        then keeps checkpointing (async background writer, cadence
+        PADDLE_CKPT_SAVE_STEPS / PADDLE_CKPT_INTERVAL_S), arms the
+        SIGTERM preemption handler (checkpoint-then-stop) and the
+        watchdog checkpoint-then-abort hook. For a deterministic
+        resumed data order pass a DataLoader over a seeded
+        BatchSampler (or shuffle=False)."""
         # failure forensics: distributed fits (or PADDLE_FLIGHT_AUTOARM
         # =1) get the collective/compile watchdog + crash-bundle
         # excepthook armed before the first step
@@ -351,6 +378,68 @@ class Model:
         eval_loader = (self._as_loader(eval_data, batch_size, False, False,
                                        num_workers)
                        if eval_data is not None else None)
+        # -- elastic resume: restore state + cursor, arm preemption ---
+        start_epoch = 0
+        mgr = None
+        if resume:
+            from ..incubate.checkpoint import elastic as _elastic
+
+            explicit = (resume if isinstance(resume, str)
+                        and resume not in ("auto", "true", "True")
+                        else None)
+            # reuse the manager a previous fit left on this model
+            # (keeps its cursor/step and any callback's cached
+            # reference valid) unless a different dir was requested
+            mgr = self._ckpt_manager
+            if mgr is None or (explicit is not None
+                               and mgr.dir != explicit):
+                mgr = _elastic.CheckpointManager(dir=explicit)
+            cursor = self._restore_training_state(mgr)
+            if cursor is not None:
+                start_epoch = int(cursor["epoch"])
+                skip = int(cursor["step_in_epoch"])
+                n_steps = self._safe_len(loader)
+                if n_steps is not None and skip >= n_steps:
+                    # snapshot landed on an epoch boundary
+                    start_epoch += 1
+                    skip = 0
+                    mgr.cursor = {"epoch": start_epoch,
+                                  "step_in_epoch": 0,
+                                  "global_step":
+                                      cursor["global_step"]}
+                bs = getattr(loader, "batch_sampler", None)
+                if bs is not None and hasattr(bs, "set_state_dict"):
+                    bs.set_state_dict({"epoch": start_epoch,
+                                       "consumed": skip})
+                    if skip and not getattr(
+                            bs, "_resume_deterministic", True):
+                        import warnings
+
+                        warnings.warn(
+                            "elastic resume: the batch sampler's "
+                            "shuffle is unseeded, so the resumed "
+                            "epoch replays a DIFFERENT permutation "
+                            "and the cursor fast-forward skips "
+                            "other samples — pass a "
+                            "BatchSampler(seed=...) (or "
+                            "shuffle=False) for bit-identical "
+                            "resume", RuntimeWarning)
+                elif skip:
+                    import warnings
+
+                    warnings.warn(
+                        "elastic resume: the data pipeline has no "
+                        "resumable batch_sampler; restarting the "
+                        "epoch from its first batch", RuntimeWarning)
+                    # the cursor must describe what actually happens:
+                    # the epoch REPLAYS from batch 0, so snapshots
+                    # taken this epoch must not inherit the old
+                    # step_in_epoch (a second resume would then skip
+                    # batches that were never trained)
+                    mgr.cursor = dict(mgr.cursor or {},
+                                      step_in_epoch=0)
+            mgr.arm()  # SIGTERM checkpoint-then-stop + watchdog hook
+            self._ckpt_manager = mgr
         cbks = cb_mod.config_callbacks(callbacks, model=self,
                                        epochs=epochs,
                                        steps=self._safe_len(loader),
@@ -359,6 +448,22 @@ class Model:
                                        verbose=verbose,
                                        metrics=["loss"] + [
                                            m.name() for m in self._metrics])
+        if mgr is not None and not any(
+                isinstance(c, cb_mod.ModelCheckpoint)
+                and getattr(c, "training_state", False)
+                for c in cbks.callbacks):
+            saver = cb_mod.ModelCheckpoint(training_state=True)
+            saver.set_model(self)
+            cbks.callbacks.append(saver)
+        # training-state savers must observe POST-LRScheduler state:
+        # the snapshot at step s must hold the schedule the NEXT step
+        # runs at, or a resumed step s+1 trains at a stale lr
+        ts_savers = [c for c in cbks.callbacks
+                     if isinstance(c, cb_mod.ModelCheckpoint)
+                     and getattr(c, "training_state", False)]
+        for c in ts_savers:
+            cbks.callbacks.remove(c)
+        cbks.callbacks.extend(ts_savers)
         cbks.on_begin("train")
         iters_done = 0
         loss = [0.0]
@@ -402,7 +507,7 @@ class Model:
             # (the excepthook fires too late for that evidence).
             # PADDLE_FLIGHT_AUTOARM=0 disarms it like the excepthook.
             with _memory.auto_oom_observer():
-                for epoch in range(epochs):
+                for epoch in range(start_epoch, epochs):
                     cbks.on_epoch_begin(epoch)
                     for m in self._metrics:
                         m.reset()
@@ -416,16 +521,21 @@ class Model:
                         pending.append((step, ins, lbls, bs))
                         if len(pending) >= k_fused:
                             _flush_pending()
+                            if self.stop_training:
+                                break  # preemption: stop at the
+                                # boundary the saver just checkpointed
                             if (num_iters is not None
                                     and iters_done >= num_iters):
                                 break
                     _flush_pending()  # ragged/short tail group
                     cbks.on_epoch_end(epoch, {"loss": loss[0]})
-                    if eval_loader is not None \
+                    preempted = (mgr is not None
+                                 and mgr.preempted.is_set())
+                    if eval_loader is not None and not preempted \
                             and (epoch + 1) % eval_freq == 0:
                         self.evaluate(eval_loader,
                                       batch_size=batch_size, verbose=0)
-                    if save_dir is not None \
+                    if save_dir is not None and not preempted \
                             and (epoch + 1) % save_freq == 0:
                         self.save(f"{save_dir}/epoch_{epoch}")
                     if self.stop_training:
@@ -454,6 +564,12 @@ class Model:
                 self._tail_step = None
             self._fit_accum = 1
             self._accum_seen = 0
+            self._pending_opt_restore = None  # consumed (or stale)
+            if mgr is not None:
+                # drain the async writer + disarm signal/watchdog
+                # hooks; the manager stays on self._ckpt_manager so a
+                # later fit(resume=...) reuses its cursor/config
+                mgr.close()
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -509,6 +625,98 @@ class Model:
         if (not reset_optimizer and self._optimizer is not None
                 and os.path.exists(opt_path)):
             self._optimizer.set_state_dict(framework.load(opt_path))
+
+    # -- elastic training state (incubate.checkpoint.elastic) -------------
+    def _live_compiler(self):
+        """The compiler holding the CANONICAL live optimizer state:
+        _compiled_step is kept canonical by the fused/tail adopt
+        dance; a retired _stale_step still holds it between fits."""
+        for c in (self._compiled_step, self._tail_step,
+                  self._stale_step):
+            if c and getattr(c, "_opt_state", None) is not None:
+                return c
+        return None
+
+    def _training_state(self):
+        """Full training-state snapshot (host-copyable live arrays):
+        model params+buffers, optimizer slots (off the live compiled
+        step's donated buffers when one exists, keyed by STRUCTURED
+        parameter names so they survive a process restart), gradient-
+        merge accumulators, scheduler/step scalars, and the rng
+        key+counter. Taken at a step boundary — between dispatches the
+        arrays are the last step's committed outputs, never donated-
+        in-flight buffers."""
+        from ..ops import random as _random
+        from ..optimizer.lr import LRScheduler as _Sched
+
+        comp = self._live_compiler()
+        if comp is not None:
+            slots = comp._opt_state
+            accum = comp._accum_state or None
+        else:
+            accum = None
+            slots = {}
+            if self._optimizer is not None:
+                # eager accumulators key by p.name (process-specific
+                # generated names) — re-key by structured name
+                slots = self._optimizer._slot_state(
+                    list(self.network.named_parameters()))
+        opt_meta = {"step_count": 0, "lr_sched": None}
+        if self._optimizer is not None:
+            opt_meta["step_count"] = int(self._optimizer._step_count)
+            lr = self._optimizer._learning_rate
+            if isinstance(lr, _Sched):
+                opt_meta["lr_sched"] = lr.state_dict()
+        key_data, counter = _random.get_rng_state()
+        return {
+            "model": dict(self.network.state_dict()),
+            "opt_slots": slots,
+            "opt_accum": accum,
+            "opt_meta": opt_meta,
+            "rng": {"key": np.asarray(key_data),
+                    "counter": int(counter)},
+        }
+
+    def _restore_training_state(self, mgr):
+        """Apply the newest valid snapshot from `mgr`: params/buffers
+        into the network, scheduler/step scalars + eager slots into
+        the optimizer, rng state globally, and the compiled-format
+        slots as a pending preload the next compiler build
+        materializes. Returns mgr.cursor (None = fresh start)."""
+        from ..ops import random as _random
+        from ..optimizer.lr import LRScheduler as _Sched
+
+        state = mgr.restore()
+        if state is None:
+            return None
+        self.network.set_state_dict(state["model"])
+        slots = state.get("opt_slots") or {}
+        opt = self._optimizer
+        if opt is not None:
+            om = state.get("opt_meta") or {}
+            opt._step_count = int(om.get("step_count", 0))
+            sd = om.get("lr_sched")
+            if sd is not None and isinstance(opt._learning_rate,
+                                             _Sched):
+                opt._learning_rate.set_state_dict(sd)
+            # eager-path slots (the compiled path preloads below)
+            opt._load_slot_state(
+                slots, list(self.network.named_parameters()))
+        rng = state.get("rng")
+        if rng is not None:
+            _random.set_rng_state((np.asarray(rng["key"]),
+                                   int(rng["counter"])))
+        cur = mgr.cursor or {}
+        self._pending_opt_restore = {
+            "slots": slots,
+            "accum": state.get("opt_accum"),
+            "step": int(cur.get("global_step", 0))}
+        # a live compiler from a PREVIOUS fit holds pre-restore state;
+        # retire it so the next build starts from the checkpoint
+        self._compiled_step = None
+        self._tail_step = None
+        self._stale_step = None
+        return mgr.cursor
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters()

@@ -1,2 +1,4 @@
 """paddle.incubate.checkpoint namespace."""
 from . import auto_checkpoint
+from . import elastic
+from .elastic import CheckpointManager  # noqa: F401

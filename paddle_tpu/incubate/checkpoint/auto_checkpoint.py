@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import pickle
 import time
 
 __all__ = ["AutoCheckpointChecker", "train_epoch_range", "register",
@@ -163,8 +164,14 @@ class _Range:
                     if os.path.exists(p):
                         obj.set_state_dict(framework.load(p))
                 return int(meta["epoch"])
-            except (OSError, ValueError, KeyError):
-                continue  # torn snapshot — try the previous one
+            except (OSError, ValueError, KeyError, EOFError,
+                    pickle.UnpicklingError):
+                # torn snapshot — try the previous one. A truncated
+                # .pd raises UnpicklingError (or EOFError at the very
+                # start of the stream), neither of which the original
+                # OSError/ValueError/KeyError net caught: the restore
+                # died on exactly the crash it existed to survive.
+                continue
         return -1
 
     def due(self, epoch, save_inter_epochs, max_epoch_num):

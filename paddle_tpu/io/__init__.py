@@ -169,10 +169,36 @@ class WeightedRandomSampler(Sampler):
 
 
 class BatchSampler(Sampler):
+    """Groups sampler indices into batches. Elastic-training hooks
+    (incubate.checkpoint / Model.fit(resume=...)):
+
+      * `seed` + shuffle=True makes the per-epoch shuffle
+        DETERMINISTIC (RandomState(seed + epoch)) and auto-reshuffled
+        each epoch — required for bit-identical resume; seed=None
+        keeps the legacy global-RNG shuffle.
+      * `state_dict()`/`set_state_dict()` expose an (epoch, consumed)
+        cursor; restoring fast-forwards the next iteration past the
+        already-consumed batches. Note: a prefetching pipeline FETCHES
+        ahead of the train loop, so mid-epoch cursors read from the
+        sampler overcount by the prefetch depth — Model.fit's
+        checkpoint callback records its own consumed-step cursor and
+        restores through set_state_dict, which is exact.
+    """
+
     def __init__(self, dataset=None, sampler=None, shuffle=False,
-                 batch_size=1, drop_last=False):
+                 batch_size=1, drop_last=False, seed=None):
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self._consumed = 0  # batches served (or skipped) this epoch
+        self._skip = 0      # fast-forward pending from set_state_dict
+        # the seeded shuffle may only replace an INTERNAL
+        # RandomSampler — an explicit sampler carries its own policy
+        # (weighted, subset, ...) that a uniform permutation of
+        # positions would silently discard
+        self._auto_sampler = sampler is None
         if sampler is not None:
             self.sampler = sampler
         elif shuffle:
@@ -180,15 +206,63 @@ class BatchSampler(Sampler):
         else:
             self.sampler = SequenceSampler(dataset)
 
+    def _index_order(self):
+        if self.shuffle and self.seed is not None \
+                and getattr(self, "_auto_sampler", True):
+            rng = np.random.RandomState(
+                (int(self.seed) + self._epoch) % (2 ** 32))
+            return iter(rng.permutation(len(self.sampler)).tolist())
+        return iter(self.sampler)
+
     def __iter__(self):
+        skip, self._skip = self._skip, 0
+        self._consumed = 0
+        n_batch = 0
         batch = []
-        for idx in self.sampler:
+        for idx in self._index_order():
             batch.append(idx)
             if len(batch) == self.batch_size:
-                yield batch
+                n_batch += 1
+                self._consumed = n_batch
+                if n_batch > skip:
+                    yield batch
                 batch = []
         if batch and not self.drop_last:
-            yield batch
+            n_batch += 1
+            self._consumed = n_batch
+            if n_batch > skip:
+                yield batch
+        # a fully consumed epoch advances the (seeded) shuffle — an
+        # abandoned iterator (break) leaves the epoch in place so a
+        # re-iteration replays the same order
+        self._epoch += 1
+        self._consumed = 0
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+        self._consumed = 0
+        self._skip = 0
+
+    @property
+    def _resume_deterministic(self):
+        """Does replaying an epoch yield the same index order? If
+        not, a restored (epoch, consumed) cursor fast-forwards past a
+        DIFFERENT permutation — resume still runs, but not
+        bit-identically (Model.fit warns)."""
+        if self._auto_sampler:
+            return (not self.shuffle) or self.seed is not None
+        return isinstance(self.sampler, SequenceSampler)
+
+    def state_dict(self):
+        return {"epoch": self._epoch, "consumed": self._consumed,
+                "seed": self.seed}
+
+    def set_state_dict(self, state):
+        self._epoch = int(state.get("epoch", 0))
+        self._consumed = int(state.get("consumed", 0))
+        self._skip = self._consumed
+        if state.get("seed") is not None:
+            self.seed = state["seed"]
 
     def __len__(self):
         n = len(self.sampler)
@@ -214,6 +288,8 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        self._consumed = 0
+        self._skip = 0
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
@@ -226,14 +302,23 @@ class DistributedBatchSampler(BatchSampler):
         indices = np.concatenate(
             [indices, indices[:self.total_size - n]])
         indices = indices[self.local_rank:self.total_size:self.nranks]
+        skip, self._skip = self._skip, 0
+        self._consumed = 0
+        n_batch = 0
         batch = []
         for idx in indices:
             batch.append(int(idx))
             if len(batch) == self.batch_size:
-                yield batch
+                n_batch += 1
+                self._consumed = n_batch
+                if n_batch > skip:
+                    yield batch
                 batch = []
         if batch and not self.drop_last:
-            yield batch
+            n_batch += 1
+            self._consumed = n_batch
+            if n_batch > skip:
+                yield batch
 
     def __len__(self):
         if self.drop_last:
@@ -242,6 +327,22 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+        self._consumed = 0
+        self._skip = 0
+
+    # -- elastic resume (epoch is the shuffle seed here: set_epoch
+    # discipline, reference batch_sampler.py) -------------------------
+    @property
+    def _resume_deterministic(self):
+        return True  # the epoch number IS the shuffle seed
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed": self._consumed}
+
+    def set_state_dict(self, state):
+        self.epoch = int(state.get("epoch", 0))
+        self._consumed = int(state.get("consumed", 0))
+        self._skip = self._consumed
 
 
 def get_worker_info():
@@ -470,6 +571,29 @@ class DataLoader:
         if self.batch_sampler is None:
             return len(self.dataset)
         return len(self.batch_sampler)
+
+    # -- elastic resume ----------------------------------------------
+    def state_dict(self):
+        """Resumable-position cursor (delegates to the batch sampler's
+        (epoch, consumed) state). IterableDataset pipelines have no
+        replayable cursor and raise."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "state_dict"):
+            raise TypeError(
+                "DataLoader.state_dict() needs a batch_sampler with "
+                "state (IterableDataset pipelines are not resumable)")
+        return {"batch_sampler": bs.state_dict()}
+
+    def set_state_dict(self, state):
+        """Restore the cursor: the next __iter__ replays the saved
+        epoch's (seeded) order and fast-forwards past the consumed
+        batches."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "set_state_dict"):
+            raise TypeError(
+                "DataLoader.set_state_dict() needs a batch_sampler "
+                "with state")
+        bs.set_state_dict(state.get("batch_sampler", state))
 
     def _fetch(self, indices, to_device=True):
         # io telemetry: this runs on the CALLING thread — under the

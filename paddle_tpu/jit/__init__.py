@@ -784,6 +784,8 @@ class TrainStepCompiler:
         self._opt_state = None
         self._step = 0
         self._mem_analysis = None  # memory_analysis() byte dict
+        self._restored_opt = None    # elastic-checkpoint preload
+        self._restored_accum = None  # (applied at first build)
         _live_compiled.add(self)
 
     def _params_and_buffers(self):
@@ -973,6 +975,51 @@ class TrainStepCompiler:
              for k, p in t_items}
             if self._accum_steps > 1 else {})
 
+    def restore_state(self, slots, step, accum=None):
+        """Preload optimizer state captured by an elastic checkpoint
+        (incubate.checkpoint.elastic): `slots` is the host pytree
+        {param_name: {slot: array}} a snapshot recorded off a live
+        compiler's _opt_state (or the eager accumulators), `step` the
+        global microstep counter (it seeds the per-dispatch rng
+        fold-in, so bit-identical resume NEEDS it), `accum` the
+        gradient-merge buffers mid-window. The arrays are materialized
+        — with this compiler's slot shardings, so a RESHAPED mesh
+        re-shards them — when the step first builds; adopting a
+        sibling's live state supersedes the preload."""
+        self._restored_opt = {
+            n: {s: np.asarray(v) for s, v in sl.items()}
+            for n, sl in (slots or {}).items()}
+        self._restored_accum = (
+            {n: np.asarray(v) for n, v in accum.items()}
+            if accum else None)
+        self._step = int(step)
+
+    def _apply_restored_state(self):
+        """Overwrite the freshly initialized (zeroed, sharded) opt/
+        accum state with the checkpointed host arrays, placed onto
+        each slot's existing sharding. Shape mismatches (a changed
+        model) keep the fresh zeros for that slot."""
+        restored, self._restored_opt = self._restored_opt, None
+        for name, slots in restored.items():
+            cur = self._opt_state.get(name)
+            if cur is None:
+                continue
+            for sname, host in slots.items():
+                ref = cur.get(sname)
+                if ref is None:
+                    cur[sname] = jnp.asarray(host)
+                elif tuple(np.shape(host)) == tuple(np.shape(ref)):
+                    cur[sname] = jax.device_put(
+                        host.astype(ref.dtype), ref.sharding)
+        racc, self._restored_accum = self._restored_accum, None
+        if racc and self._accum_state:
+            for name, host in racc.items():
+                ref = self._accum_state.get(name)
+                if ref is not None and tuple(np.shape(host)) == \
+                        tuple(np.shape(ref)):
+                    self._accum_state[name] = jax.device_put(
+                        host.astype(ref.dtype), ref.sharding)
+
     def adopt_state_from(self, other):
         """Take over `other`'s live optimizer/accumulator state and
         step counter. For two compilers over the SAME model/optimizer
@@ -983,6 +1030,9 @@ class TrainStepCompiler:
         already-donated — buffers back into its program."""
         if other is None or other._opt_state is None:
             return
+        # live adopted state supersedes a checkpoint preload
+        self._restored_opt = None
+        self._restored_accum = None
         self._opt_state = other._opt_state
         if self._accum_steps == getattr(other, "_accum_steps", 1):
             self._accum_state = other._accum_state
@@ -1010,6 +1060,11 @@ class TrainStepCompiler:
         b_items = list(bufs.items())
         if self._opt_state is None:  # not adopted from a sibling
             self._init_opt_state(t_items)
+            if self._restored_opt is not None:
+                # elastic-checkpoint preload: replace the fresh zeros
+                # (already placed per slot sharding) with the
+                # snapshot's host arrays on the same shardings
+                self._apply_restored_state()
 
         import contextlib
 

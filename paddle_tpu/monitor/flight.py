@@ -68,6 +68,7 @@ __all__ = [
     "dump_dir", "install_excepthook", "uninstall_excepthook",
     "dump_on_crash", "install_signal_handler",
     "uninstall_signal_handler", "arm", "maybe_auto_arm",
+    "add_incident_hook", "remove_incident_hook",
 ]
 
 DUMP_SCHEMA = "paddle_tpu.flight/1"
@@ -462,6 +463,41 @@ def write_dump(reason, extra=None, path=None, full_memory=None):
 
 
 # ---------------------------------------------------------------------------
+# Incident hooks (watchdog checkpoint-then-abort)
+# ---------------------------------------------------------------------------
+
+# callables fired with (reason) after an incident dump lands — the
+# elastic CheckpointManager registers its emergency_save here so a
+# hung collective leaves a RESUMABLE snapshot next to the bundle, not
+# just an autopsy (ROADMAP item 4 "checkpoint-then-abort")
+_incident_hooks: list = []
+
+
+def add_incident_hook(fn):
+    """Register fn(reason) to run after a watchdog incident dump.
+    Hooks must be best-effort: exceptions are counted under
+    flight/incident_hook/errors and never reach the watchdog loop."""
+    if fn not in _incident_hooks:
+        _incident_hooks.append(fn)
+    return fn
+
+
+def remove_incident_hook(fn):
+    try:
+        _incident_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_incident_hooks(reason):
+    for fn in list(_incident_hooks):
+        try:
+            fn(reason)
+        except Exception:
+            _cmon.stat_add("flight/incident_hook/errors", 1)
+
+
+# ---------------------------------------------------------------------------
 # Watchdog
 # ---------------------------------------------------------------------------
 
@@ -571,6 +607,22 @@ class Watchdog:
         self._reported |= {tok for tok, _ in stuck}
         self.fired += 1
         _cmon.stat_add("flight/watchdog/fires", 1)
+        # checkpoint-then-abort: incident hooks run AFTER the dump is
+        # durable (the bundle is cheap and certain; a checkpoint may
+        # take seconds and can itself wedge — its ckpt_write span
+        # would then show in the NEXT dump)
+        _run_incident_hooks("watchdog")
+        if _env_on("PADDLE_WATCHDOG_ABORT", default=False):
+            # elastic relaunch contract: with evidence + checkpoint on
+            # disk, kill the wedged rank so the supervisor restarts
+            # the job instead of burning the reservation on a hang
+            recorder.record("watchdog_abort")
+            try:
+                _cmon.VLOG(0, "flight: watchdog aborting process "
+                              "(PADDLE_WATCHDOG_ABORT=1)")
+            except Exception:
+                pass
+            os.kill(os.getpid(), signal.SIGABRT)
         return path
 
 

@@ -222,6 +222,29 @@ class Optimizer:
 
     set_dict = set_state_dict
 
+    # -- elastic checkpoint slot state ------------------------------------
+    def _slot_state(self, named_params):
+        """Live accumulator slots re-keyed by STRUCTURED parameter
+        name (`named_parameters()` keys). The internal key — `p.name`
+        — embeds a per-process generated counter, so it cannot survive
+        a relaunch; the structured name can. This is the key space the
+        elastic training-state snapshot (incubate.checkpoint.elastic /
+        Model._training_state) stores slots under."""
+        rev = {p.name: sname for sname, p in named_params}
+        return {rev.get(pn, pn): dict(sl)
+                for pn, sl in self._accumulators.items()}
+
+    def _load_slot_state(self, slots, named_params):
+        """Inverse of _slot_state: re-key a structured-name slot tree
+        back onto this process's `p.name`s and install it as the live
+        eager accumulators (the compiled path preloads separately via
+        TrainStepCompiler.restore_state)."""
+        fwd = {sname: p.name for sname, p in named_params}
+        self._accumulators = {
+            fwd.get(n, n): {s: jnp.asarray(np.asarray(v))
+                            for s, v in sl.items()}
+            for n, sl in slots.items()}
+
     @property
     def _param_groups(self):
         return self._parameter_list

@@ -1,0 +1,70 @@
+"""Subprocess worker for the elastic-resume SIGKILL harness
+(tests/test_elastic.py): a small deterministic regression fit with
+per-step async training-state checkpoints. Each completed step appends
+"<global_step> <loss.hex()>" to $ELASTIC_LOSS_LOG (fsync'd, so lines
+survive a SIGKILL mid-run). Relaunching with the same
+PADDLE_JOB_ID/PADDLE_CKPT_DIR resumes from the newest durable snapshot
+and must reproduce the uninterrupted run's losses bit-for-bit.
+"""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+import paddle_tpu.optimizer.lr as lr
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io import BatchSampler, DataLoader, TensorDataset
+
+LOG = os.environ["ELASTIC_LOSS_LOG"]
+EPOCHS = int(os.environ.get("ELASTIC_EPOCHS", "4"))
+STALL_AT = int(os.environ.get("ELASTIC_STALL_AT", "-1"))
+
+
+class LossLog(Callback):
+    """Appends the just-completed step's (0-based) global step + loss.
+    Runs BEFORE the training-state saver (fit appends its saver last),
+    so mgr.global_step is still the pre-increment completed count."""
+
+    def on_train_batch_end(self, step, logs=None):
+        g = self.model._ckpt_manager.global_step
+        with open(LOG, "a") as f:
+            f.write(f"{g} {float(logs['loss']).hex()}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        if g == STALL_AT:
+            # parked forever: gives the parent a deterministic window
+            # to SIGKILL after step STALL_AT's checkpoint enqueued
+            self.model._ckpt_manager.flush()
+            import time
+
+            while True:
+                time.sleep(0.5)
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.RandomState(7)
+    x = rng.randn(48, 10).astype(np.float32)
+    w = rng.randn(10, 1).astype(np.float32)
+    y = (x @ w + 0.1 * rng.randn(48, 1)).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    sampler = BatchSampler(ds, shuffle=True, batch_size=8,
+                           drop_last=True, seed=11)
+    loader = DataLoader(ds, batch_sampler=sampler)
+    net = nn.Linear(10, 1)
+    model = Model(net)
+    sched = lr.StepDecay(learning_rate=0.05, step_size=5, gamma=0.5)
+    opt = optim.Adam(learning_rate=sched,
+                     parameters=net.parameters())
+    model.prepare(opt, lambda o, t: ((o - t) ** 2).mean())
+    model.fit(loader, epochs=EPOCHS, verbose=0, resume="auto",
+              callbacks=[LossLog()])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,33 @@
-"""Elastic manager + TTL KV store (reference:
-fleet/elastic/manager.py:130; store = etcd stand-in)."""
+"""Elastic training: manager + TTL KV store (reference:
+fleet/elastic/manager.py:130; store = etcd stand-in), and the
+fault-tolerant checkpoint/resume subsystem
+(incubate.checkpoint.elastic): sampler/DataLoader state_dict
+round-trips, async+rotated training-state snapshots, torn-snapshot
+fallback, watchdog/preemption emergency saves, and the SIGKILL
+mid-fit + relaunch bit-identical-resume harness."""
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
 import time
 
+import numpy as np
 import pytest
 
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
 from paddle_tpu.distributed.fleet.elastic import (
     ELASTIC_EXIT_CODE, ElasticManager, ElasticStatus, KVClient, KVStore)
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import ModelCheckpoint
+from paddle_tpu.incubate.checkpoint.elastic import CheckpointManager
+from paddle_tpu.io import (BatchSampler, DataLoader,
+                           DistributedBatchSampler, TensorDataset)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture()
@@ -89,3 +111,832 @@ def test_wait_for_world_timeout(store):
 
 def test_elastic_exit_code_constant():
     assert ELASTIC_EXIT_CODE == 101
+
+
+# ---------------------------------------------------------------------------
+# sampler / DataLoader resumable cursors
+# ---------------------------------------------------------------------------
+
+def _range_ds(n=20, width=4):
+    x = np.arange(n * width, dtype=np.float32).reshape(n, width)
+    return TensorDataset([paddle.to_tensor(x),
+                          paddle.to_tensor(x[:, :1])])
+
+
+def test_batch_sampler_seeded_shuffle_deterministic():
+    ds = _range_ds()
+    a = BatchSampler(ds, shuffle=True, batch_size=4, seed=5)
+    b = BatchSampler(ds, shuffle=True, batch_size=4, seed=5)
+    e0a, e0b = list(a), list(b)
+    assert e0a == e0b
+    # a fully consumed epoch advances the shuffle deterministically
+    e1a, e1b = list(a), list(b)
+    assert e1a == e1b and e1a != e0a
+    # set_epoch replays a past epoch's order
+    a.set_epoch(0)
+    assert list(a) == e0a
+
+
+def test_batch_sampler_abandoned_iter_replays_same_epoch():
+    ds = _range_ds()
+    s = BatchSampler(ds, shuffle=True, batch_size=4, seed=3)
+    full = [list(b) for b in BatchSampler(ds, shuffle=True,
+                                          batch_size=4, seed=3)]
+    it = iter(s)
+    next(it)  # abandon mid-epoch (no StopIteration)
+    assert list(s) == full  # same epoch-0 order, not epoch 1
+
+
+def test_batch_sampler_explicit_sampler_keeps_its_policy():
+    """seed + shuffle must NOT override an explicit sampler: a
+    weighted/subset sampling policy would silently become a uniform
+    permutation of positions."""
+    from paddle_tpu.io import SequenceSampler
+
+    ds = _range_ds(8)
+    explicit = SequenceSampler(ds)  # policy: strictly sequential
+    s = BatchSampler(ds, sampler=explicit, shuffle=True, batch_size=4,
+                     seed=5)
+    assert list(s) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_batch_sampler_state_dict_fast_forward():
+    ds = _range_ds()
+    s = BatchSampler(ds, shuffle=True, batch_size=4, seed=7)
+    it = iter(s)
+    consumed = [next(it), next(it)]
+    st = s.state_dict()
+    assert st["epoch"] == 0 and st["consumed"] == 2
+    fresh = BatchSampler(ds, shuffle=True, batch_size=4, seed=7)
+    fresh.set_state_dict(st)
+    resumed = list(fresh)
+    ref = BatchSampler(ds, shuffle=True, batch_size=4, seed=7)
+    full = list(ref)
+    assert consumed == full[:2]
+    assert resumed == full[2:]
+    # the fast-forwarded epoch still advances the shuffle on completion
+    assert list(fresh) == list(ref)
+
+
+def test_distributed_batch_sampler_state_dict_fast_forward():
+    ds = _range_ds(24)
+    kw = dict(batch_size=3, num_replicas=2, rank=1, shuffle=True)
+    ref = DistributedBatchSampler(ds, **kw)
+    ref.set_epoch(2)
+    full = list(ref)
+    s = DistributedBatchSampler(ds, **kw)
+    s.set_epoch(2)
+    it = iter(s)
+    first = next(it)
+    st = s.state_dict()
+    assert st == {"epoch": 2, "consumed": 1}
+    fresh = DistributedBatchSampler(ds, **kw)
+    fresh.set_state_dict(st)
+    assert first == full[0]
+    assert list(fresh) == full[1:]
+
+
+def test_dataloader_state_dict_round_trip():
+    ds = _range_ds()
+    sampler = BatchSampler(ds, shuffle=True, batch_size=4, seed=9)
+    loader = DataLoader(ds, batch_sampler=sampler)
+    loader.set_state_dict({"batch_sampler": {"epoch": 1,
+                                             "consumed": 2}})
+    got = [b[0] for b in loader]
+    ref_sampler = BatchSampler(ds, shuffle=True, batch_size=4, seed=9)
+    ref_sampler.set_epoch(1)
+    ref = list(ref_sampler)[2:]
+    assert len(got) == len(ref)
+    x = np.asarray(ds.tensors[0])
+    for batch, idxs in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(batch), x[idxs])
+    assert "batch_sampler" in loader.state_dict()
+
+
+def test_dataloader_state_dict_requires_resumable_sampler():
+    class _Stream(paddle.io.IterableDataset):
+        def __iter__(self):
+            yield np.zeros(2, np.float32)
+
+    loader = DataLoader(_Stream(), batch_size=None)
+    with pytest.raises(TypeError):
+        loader.state_dict()
+    with pytest.raises(TypeError):
+        loader.set_state_dict({})
+
+
+# ---------------------------------------------------------------------------
+# atomic paddle.save + torn-snapshot fallbacks (satellites)
+# ---------------------------------------------------------------------------
+
+def test_framework_save_atomic_failure_keeps_old_file(tmp_path,
+                                                      monkeypatch):
+    from paddle_tpu import framework
+
+    p = str(tmp_path / "m.pd")
+    framework.save({"a": paddle.to_tensor(np.ones(3, np.float32))}, p)
+
+    def boom(obj, f, protocol=None):
+        f.write(b"partial garbage")
+        raise OSError("disk full mid-pickle")
+
+    monkeypatch.setattr(framework.pickle, "dump", boom)
+    with pytest.raises(OSError):
+        framework.save({"a": paddle.to_tensor(
+            np.zeros(3, np.float32))}, p)
+    monkeypatch.undo()
+    # old complete checkpoint survives; no tmp droppings
+    old = framework.load(p)
+    np.testing.assert_array_equal(np.asarray(old["a"]), np.ones(3))
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+class _Stateful:
+    def __init__(self):
+        self.v = np.zeros(2, np.float32)
+
+    def state_dict(self):
+        return {"v": paddle.to_tensor(self.v)}
+
+    def set_state_dict(self, sd):
+        self.v = np.asarray(sd["v"])
+
+
+def test_auto_checkpoint_truncated_pickle_falls_back(tmp_path,
+                                                     monkeypatch):
+    """Regression (satellite): a truncated .pd raises
+    pickle.UnpicklingError, which the old OSError/ValueError/KeyError
+    net let escape — the restore died on exactly the torn-snapshot
+    crash it existed to survive."""
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path / "ac"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "tornjob")
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+
+    ac.clear_registry()
+    obj = _Stateful()
+    ac.register("obj", obj)
+    try:
+        r = ac._Range("r")
+        obj.v = np.full(2, 1.0, np.float32)
+        r.save(0)
+        obj.v = np.full(2, 2.0, np.float32)
+        r.save(1)
+        pd = os.path.join(r._epoch_dir(1), "obj.pd")
+        with open(pd, "rb") as f:
+            data = f.read()
+        with open(pd, "wb") as f:
+            f.write(data[:20])  # torn mid-stream: UnpicklingError
+        with open(pd, "rb") as f:
+            with pytest.raises((pickle.UnpicklingError, EOFError)):
+                pickle.load(f)  # the exception the old net missed
+        obj.v = None
+        assert ac._Range("r").restore() == 0
+        np.testing.assert_array_equal(obj.v, np.full(2, 1.0))
+    finally:
+        ac.clear_registry()
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: save/restore/rotation/async/emergency
+# ---------------------------------------------------------------------------
+
+def _state_tree():
+    return {
+        "model": {"w": paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(2, 3))},
+        "nested": [np.full(2, 7.0, np.float32),
+                   (np.int64(3), "tag")],
+        "scalar": 4,
+        "none": None,
+    }
+
+
+def test_hostify_owns_its_bytes():
+    """Snapshots must be OWNED copies: np.asarray of a CPU jax array
+    is a zero-copy view of the device buffer, which the next
+    dispatch's donation would mutate while the async writer (or the
+    _last emergency fallback) still holds it."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.checkpoint.elastic import _hostify
+
+    a = jnp.arange(4, dtype=jnp.float32)
+    h = _hostify({"a": a}, {})["a"]
+    assert h.flags.owndata
+    assert not np.shares_memory(h, np.asarray(a))
+
+
+def test_ckpt_manager_save_restore_round_trip(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(dir=d, save_steps=1, async_write=False)
+    mgr.save(_state_tree(), epoch=1, step_in_epoch=2, global_step=7)
+    m2 = CheckpointManager(dir=d)
+    st = m2.restore()
+    assert st is not None
+    np.testing.assert_array_equal(
+        st["model"]["w"], np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(st["nested"][0], np.full(2, 7.0))
+    assert st["nested"][1] == (np.int64(3), "tag")
+    assert st["scalar"] == 4 and st["none"] is None
+    assert m2.cursor == {"epoch": 1, "step_in_epoch": 2,
+                         "global_step": 7}
+    assert m2.global_step == 7
+    # manifest carries the schema + completeness marker
+    with open(os.path.join(d, "step_7", "manifest.json")) as f:
+        meta = json.load(f)
+    assert meta["schema"] == "paddle_tpu.ckpt/1" and meta["complete"]
+
+
+def test_ckpt_manager_rotation_keeps_newest(tmp_path):
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            max_num=2, async_write=False)
+    for g in (1, 2, 3):
+        mgr.save({"w": np.full(2, float(g), np.float32)},
+                 global_step=g)
+    assert mgr._snapshot_steps() == [2, 3]
+
+
+def test_ckpt_manager_torn_snapshots_fall_back(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(dir=d, save_steps=1, max_num=8,
+                            async_write=False)
+    for g in (5, 6):
+        mgr.save({"w": np.full(2, float(g), np.float32)},
+                 global_step=g)
+    # newest snapshot torn mid-write: truncated rank pickle
+    pd_path = os.path.join(d, "step_6", "state_rank0.pd")
+    with open(pd_path, "rb") as f:
+        data = f.read()
+    with open(pd_path, "wb") as f:
+        f.write(data[:16])
+    # a manifest-less dir (crash before publish) is skipped
+    os.makedirs(os.path.join(d, "step_9"))
+    # a complete manifest with no rank files is skipped
+    os.makedirs(os.path.join(d, "step_8"))
+    with open(os.path.join(d, "step_8", "manifest.json"), "w") as f:
+        json.dump({"complete": True, "epoch": 0, "step_in_epoch": 0,
+                   "step": 8}, f)
+    # a corrupt manifest is skipped
+    os.makedirs(os.path.join(d, "step_7"))
+    with open(os.path.join(d, "step_7", "manifest.json"), "w") as f:
+        f.write("{not json")
+    m2 = CheckpointManager(dir=d)
+    st = m2.restore()
+    np.testing.assert_array_equal(st["w"], np.full(2, 5.0))
+    assert m2.cursor["global_step"] == 5
+
+
+def test_ckpt_manager_async_latest_wins(tmp_path):
+    from paddle_tpu.core import monitor as cmon
+
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            max_num=10, async_write=True)
+    dropped0 = cmon.stat_get("ckpt/dropped")
+    mgr._write_lock.acquire()
+    try:
+        mgr.save({"w": np.zeros(2, np.float32)}, global_step=1)
+        deadline = time.monotonic() + 10
+        while not mgr._busy and time.monotonic() < deadline:
+            time.sleep(0.01)  # writer picked step 1, blocked on lock
+        assert mgr._busy
+        mgr.save({"w": np.ones(2, np.float32)}, global_step=2)
+        mgr.save({"w": np.full(2, 2.0, np.float32)}, global_step=3)
+    finally:
+        mgr._write_lock.release()
+    assert mgr.flush(timeout=30)
+    # step 2 was overtaken in the latest-wins slot, never written
+    assert mgr._snapshot_steps() == [1, 3]
+    assert cmon.stat_get("ckpt/dropped") == dropped0 + 1
+    mgr.close()
+
+
+def test_ckpt_manager_time_cadence_quantized_multirank(tmp_path):
+    """Time-based cadence under world>1 must flip at a step every
+    rank agrees on (g % 8), or rank shards land on different steps
+    and every snapshot is torn."""
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=0,
+                            save_interval_s=0.0, async_write=False)
+    assert mgr.due(7)  # single rank: interval elapsed -> save now
+    mgr.world_size = 4
+    assert not mgr.due(7)
+    assert mgr.due(8)
+    mgr.save_interval_s = 3600.0
+    mgr._last_save_t = time.monotonic()
+    assert not mgr.due(8)  # interval not elapsed
+
+
+def test_ckpt_manager_sync_save_survives_wedged_writer(tmp_path):
+    """save(sync=True) — the preemption boundary checkpoint on the
+    fit MAIN thread — must not hang behind a writer wedged on a hung
+    checkpoint FS."""
+    from paddle_tpu.core import monitor as cmon
+
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=True)
+    mgr._lock_timeout_s = 0.3
+    errs0 = cmon.stat_get("ckpt/errors")
+    mgr._write_lock.acquire()  # the wedged writer
+    try:
+        t0 = time.monotonic()
+        mgr.save({"w": np.zeros(2, np.float32)}, global_step=5,
+                 sync=True)  # returns (recorded error), no deadlock
+        assert time.monotonic() - t0 < 5
+    finally:
+        mgr._write_lock.release()
+    assert cmon.stat_get("ckpt/errors") == errs0 + 1
+    assert mgr._snapshot_steps() == []
+    mgr.close()
+
+
+def test_ckpt_manager_arm_clears_stale_preemption(tmp_path):
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"),
+                            async_write=False)
+    mgr.preempted.set()  # latched by a previous (preempted) fit
+    try:
+        mgr.arm()
+        assert not mgr.preempted.is_set()
+    finally:
+        mgr.close()
+
+
+def test_ckpt_manager_preemption_handler_uninstalls(tmp_path):
+    """Regression: `is` against a fresh bound method never matched,
+    so the handler was never restored and every fit chained another
+    layer onto the previous one."""
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"),
+                            async_write=False)
+    prev = signal.getsignal(signal.SIGUSR2)
+    assert mgr.install_preemption_handler(signal.SIGUSR2)
+    assert signal.getsignal(signal.SIGUSR2) == mgr._on_preempt_signal
+    mgr.uninstall_preemption_handler()
+    assert signal.getsignal(signal.SIGUSR2) == prev
+    # re-arm/uninstall round-trips (no self-chaining)
+    assert mgr.install_preemption_handler(signal.SIGUSR2)
+    assert mgr._prev_sig[1] == prev
+    mgr.uninstall_preemption_handler()
+
+
+def test_ckpt_manager_restore_ignores_stale_extra_rank_files(
+        tmp_path):
+    """A step dir rewritten after a world shrink may hold the old
+    world's higher-rank shards; restore must only merge the ranks
+    the manifest's world wrote."""
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(dir=d, save_steps=1, max_num=8,
+                            async_write=False)
+    mgr.save({"w": np.full(2, 5.0, np.float32)}, global_step=5)
+    mgr.save({"w": np.full(2, 6.0, np.float32)}, global_step=6)
+    # stale world-4 leftover in the newest dir
+    with open(os.path.join(d, "step_6", "state_rank3.pd"),
+              "wb") as f:
+        pickle.dump({"schema": "paddle_tpu.ckpt/1",
+                     "state": {"w": np.full(2, 99.0, np.float32)}},
+                    f)
+    m2 = CheckpointManager(dir=d)
+    st = m2.restore()
+    np.testing.assert_array_equal(st["w"], np.full(2, 6.0))
+    # a manifest claiming MORE ranks than are on disk is skipped
+    # (missing shard), falling back to the previous snapshot
+    man = os.path.join(d, "step_6", "manifest.json")
+    with open(man) as f:
+        meta = json.load(f)
+    meta["world_size"] = 2
+    with open(man, "w") as f:
+        json.dump(meta, f)
+    m3 = CheckpointManager(dir=d)
+    st = m3.restore()
+    np.testing.assert_array_equal(st["w"], np.full(2, 5.0))
+
+
+def test_ckpt_manager_sync_save_swallows_write_errors(tmp_path,
+                                                      monkeypatch):
+    """A failing boundary checkpoint (disk full) on the fit main
+    thread must be recorded, not crash checkpoint-then-stop."""
+    from paddle_tpu.core import monitor as cmon
+    from paddle_tpu.incubate.checkpoint import elastic as el
+
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    errs0 = cmon.stat_get("ckpt/errors")
+
+    def boom(path, payload):
+        raise OSError("no space left on device")
+
+    monkeypatch.setattr(el, "_atomic_write_bytes", boom)
+    mgr.save({"w": np.zeros(2, np.float32)}, global_step=1)  # no raise
+    assert cmon.stat_get("ckpt/errors") == errs0 + 1
+
+
+def test_ckpt_manager_close_releases_last_capture(tmp_path):
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    mgr.save({"w": np.zeros(2, np.float32)}, global_step=1)
+    assert mgr._last is not None
+    mgr.close()
+    assert mgr._last is None  # snapshot-sized host RAM released
+
+
+def test_model_checkpoint_no_partial_epoch_save_on_preemption(
+        tmp_path):
+    """The preemption break leaves the epoch incomplete; its
+    {epoch}.pdparams must not be written (rotation could displace a
+    REAL epoch snapshot with the half-trained one)."""
+    model, _ = _tiny_fit_parts()
+    d = str(tmp_path / "ckdir")
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"),
+                            async_write=False)
+    model._ckpt_manager = mgr
+    cb = ModelCheckpoint(save_freq=1, save_dir=d)
+    cb.set_model(model)
+    mgr.preempted.set()
+    cb.on_epoch_end(0)
+    assert not os.path.exists(os.path.join(d, "0.pdparams"))
+    mgr.preempted.clear()
+    cb.on_epoch_end(0)
+    assert os.path.exists(os.path.join(d, "0.pdparams"))
+
+
+def test_ckpt_manager_emergency_save_uses_provider(tmp_path):
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    mgr.set_state_provider(
+        lambda: ({"w": np.full(2, 9.0, np.float32)},
+                 {"epoch": 1, "step_in_epoch": 4, "global_step": 9}))
+    assert mgr.emergency_save("watchdog") == 9
+    m2 = CheckpointManager(dir=str(tmp_path / "ck"))
+    st = m2.restore()
+    np.testing.assert_array_equal(st["w"], np.full(2, 9.0))
+    assert m2.cursor == {"epoch": 1, "step_in_epoch": 4,
+                         "global_step": 9}
+    with open(os.path.join(str(tmp_path / "ck"), "step_9",
+                           "manifest.json")) as f:
+        assert json.load(f)["reason"] == "watchdog"
+
+
+def test_ckpt_manager_emergency_save_falls_back_to_last_capture(
+        tmp_path):
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=True, max_num=10)
+    # captured (self._last set) but pretend nothing is durable yet
+    mgr._write_lock.acquire()
+    try:
+        mgr.save({"w": np.full(2, 3.0, np.float32)}, global_step=3)
+    finally:
+        mgr._write_lock.release()
+    mgr.flush(30)
+
+    def bad_provider():
+        raise RuntimeError("donated buffers mid-dispatch")
+
+    mgr.set_state_provider(bad_provider)
+    # step 3 is already durable -> nothing newer to write
+    assert mgr.emergency_save("preempt") is None
+    # newer capture pending: emergency writes it synchronously
+    mgr._durable_step = 2
+    assert mgr.emergency_save("preempt") == 3
+    mgr.close()
+
+
+def test_ckpt_manager_preemption_signal(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "fl"))
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    mgr._preempt_grace_s = 0.2  # no live fit loop to wait for here
+    mgr.set_state_provider(
+        lambda: ({"w": np.full(2, 5.0, np.float32)},
+                 {"epoch": 0, "step_in_epoch": 5, "global_step": 5}))
+    assert mgr.install_preemption_handler(signal.SIGUSR2)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 15
+        while (5 not in mgr._snapshot_steps()
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+    finally:
+        mgr.uninstall_preemption_handler()
+    assert mgr.preempted.is_set()
+    assert mgr.due(123)  # preemption forces the next boundary save
+    assert 5 in mgr._snapshot_steps()
+
+
+def test_watchdog_incident_hook_checkpoint_then_abort(tmp_path,
+                                                      monkeypatch):
+    """A watchdog fire runs the incident hooks: an armed manager
+    leaves a RESUMABLE snapshot next to the flight bundle."""
+    from paddle_tpu.monitor import flight
+
+    monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "fl"))
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    mgr.set_state_provider(
+        lambda: ({"w": np.full(2, 7.0, np.float32)},
+                 {"epoch": 0, "step_in_epoch": 7, "global_step": 7}))
+    mgr.arm()
+    try:
+        flight._run_incident_hooks("watchdog")
+        assert mgr._snapshot_steps() == [7]
+    finally:
+        mgr.close()
+    assert mgr._on_incident not in flight._incident_hooks
+
+
+def test_elastic_manager_scale_event_emergency_checkpoint(store,
+                                                          tmp_path):
+    """distributed/fleet/elastic x incubate.checkpoint: the first
+    health() poll that sees a membership change writes an emergency
+    snapshot, so the reshaped relaunch resumes from the last
+    completed step."""
+    import shutil
+
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    # a boundary capture exists but (say the writer was mid-flight)
+    # is not durable — the scale hook must publish THAT, and must not
+    # take a fresh device capture (health() polls run concurrently
+    # with live donated dispatches)
+    mgr.save({"w": np.full(2, 3.0, np.float32)}, epoch=0,
+             step_in_epoch=3, global_step=3)
+    shutil.rmtree(mgr.dir)
+    mgr._durable_step = -1
+    live_captures = []
+    mgr.set_state_provider(
+        lambda: (live_captures.append(1),
+                 ({"w": np.zeros(2, np.float32)}, {}))[1])
+    m0 = ElasticManager(store.endpoint, "jscale", host="n0",
+                        np_min=1, np_max=3, ttl=2.0, elastic_level=2)
+    m0.register()
+    m0.attach_checkpoint_manager(mgr)
+    assert m0.health() == ElasticStatus.COMPLETED
+    assert mgr._snapshot_steps() == []  # stable world: no snapshot
+    m1 = ElasticManager(store.endpoint, "jscale", host="n1",
+                        np_min=1, np_max=3, ttl=2.0, elastic_level=2)
+    m1.register()
+    assert m0.health() == ElasticStatus.RESTART
+    assert mgr._snapshot_steps() == [3]  # republished from _last
+    assert not live_captures  # never captured live device state
+    # same membership polled again: saved once, not per poll
+    from paddle_tpu.core import monitor as cmon
+
+    n = cmon.stat_get("ckpt/emergency_saves")
+    assert m0.health() == ElasticStatus.RESTART
+    assert cmon.stat_get("ckpt/emergency_saves") == n
+    m0.exit()
+    m1.exit()
+
+
+# ---------------------------------------------------------------------------
+# hapi integration: ModelCheckpoint rotation + training-state snapshots
+# ---------------------------------------------------------------------------
+
+def _tiny_fit_parts(n=16, batch=4):
+    paddle.seed(0)
+    rng = np.random.RandomState(3)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x @ rng.randn(4, 1)).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    loader = DataLoader(ds, batch_sampler=BatchSampler(
+        ds, shuffle=False, batch_size=batch))
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(optim.SGD(learning_rate=0.05,
+                            parameters=net.parameters()),
+                  lambda o, t: ((o - t) ** 2).mean())
+    return model, loader
+
+
+def test_model_checkpoint_rotates_epoch_snapshots(tmp_path):
+    model, loader = _tiny_fit_parts()
+    d = str(tmp_path / "ckdir")
+    cb = ModelCheckpoint(save_freq=1, save_dir=d, max_checkpoint_num=2)
+    model.fit(loader, epochs=4, verbose=0, callbacks=[cb])
+    kept = sorted(f for f in os.listdir(d) if f.endswith(".pdparams"))
+    assert kept == ["2.pdparams", "3.pdparams", "final.pdparams"]
+    # rotation removed the optimizer halves too
+    assert not os.path.exists(os.path.join(d, "0.pdopt"))
+
+
+def test_model_checkpoint_training_state_snapshots(tmp_path):
+    model, loader = _tiny_fit_parts()
+    d = str(tmp_path / "ckdir")
+    cb = ModelCheckpoint(save_dir=d, training_state=True, save_steps=2)
+    model.fit(loader, epochs=2, verbose=0, callbacks=[cb])
+    snap_dir = os.path.join(d, "training_state")
+    steps = CheckpointManager(dir=snap_dir)._snapshot_steps()
+    assert steps, "no training-state snapshots written"
+    st = CheckpointManager(dir=snap_dir).restore()
+    assert set(st) >= {"model", "opt_slots", "opt_meta", "rng"}
+    assert model._ckpt_manager is not None
+
+
+def test_model_checkpoint_tracks_live_manager(tmp_path):
+    """fit(resume=) may swap model._ckpt_manager; a callback cached
+    against the old manager would miss the new one's preemption flag
+    and never feed its state provider."""
+    model, _ = _tiny_fit_parts()
+    old = CheckpointManager(dir=str(tmp_path / "a"), async_write=False)
+    new = CheckpointManager(dir=str(tmp_path / "b"), async_write=False)
+    cb = ModelCheckpoint(training_state=True)
+    cb.set_model(model)
+    model._ckpt_manager = old
+    assert cb._manager() is old
+    model._ckpt_manager = new  # a later fit installed its manager
+    assert cb._manager() is new
+
+
+def test_fit_resume_unseeded_shuffle_warns(tmp_path, monkeypatch):
+    """Fast-forwarding a mid-epoch cursor through an UNSEEDED shuffle
+    replays a different permutation — resume proceeds but must say
+    the run is no longer bit-identical."""
+    monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path / "root"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "warn_job")
+    model, _ = _tiny_fit_parts()
+    # handcraft a mid-epoch snapshot for this model
+    mgr = CheckpointManager(save_steps=1, async_write=False)
+    mgr.save(model._training_state(), epoch=0, step_in_epoch=2,
+             global_step=2)
+    ds = _range_ds(16)
+    loader = DataLoader(ds, batch_sampler=BatchSampler(
+        ds, shuffle=True, batch_size=4))  # shuffle WITHOUT seed
+    with pytest.warns(RuntimeWarning, match="unseeded"):
+        model.fit(loader, epochs=1, verbose=0, resume="auto")
+
+
+def test_fit_resume_non_resumable_sampler_resets_cursor(tmp_path,
+                                                        monkeypatch):
+    """When the pipeline can't fast-forward, the epoch replays from
+    batch 0 — the cursor must say so, or snapshots taken during the
+    replay overcount step_in_epoch and a SECOND resume skips batches
+    that were never trained."""
+    monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path / "root"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "nr_job")
+    model, _ = _tiny_fit_parts()
+    mgr = CheckpointManager(async_write=False)
+    mgr.save(model._training_state(), epoch=0, step_in_epoch=2,
+             global_step=2)
+
+    class _Plain:  # no state_dict/set_state_dict
+        batch_size = 4
+
+        def __iter__(self):
+            return iter([list(range(i, i + 4))
+                         for i in range(0, 16, 4)])
+
+        def __len__(self):
+            return 4
+
+    loader = DataLoader(_range_ds(16), batch_sampler=_Plain())
+    with pytest.warns(RuntimeWarning, match="restarting the epoch"):
+        model.fit(loader, epochs=1, verbose=0, resume="auto")
+    assert model._ckpt_manager.cursor["step_in_epoch"] == 0
+
+
+def test_model_checkpoint_ignores_stale_resume_cursor(tmp_path):
+    """A manager kept across fits must not replay its old restore
+    cursor into a later fit's epoch (resume would then skip batches
+    that were never trained)."""
+    model, _ = _tiny_fit_parts()
+    mgr = CheckpointManager(dir=str(tmp_path / "ck"), save_steps=1,
+                            async_write=False)
+    mgr.cursor = {"epoch": 1, "step_in_epoch": 2, "global_step": 8}
+    mgr.global_step = 12  # a later fit already trained past it
+    model._ckpt_manager = mgr
+    cb = ModelCheckpoint(training_state=True)
+    cb.set_model(model)
+    cb.on_epoch_begin(1)
+    assert cb._step_in_epoch == 0  # stale: NOT fast-forwarded
+    mgr.global_step = 8  # the boundary the cursor describes
+    cb.on_epoch_begin(1)
+    assert cb._step_in_epoch == 2  # genuine resumed mid-epoch
+
+
+def test_fit_resume_auto_fresh_start_then_restore(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path / "root"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "fit_resume_job")
+    monkeypatch.setenv("PADDLE_CKPT_SAVE_STEPS", "1")
+    model, loader = _tiny_fit_parts()
+    model.fit(loader, epochs=1, verbose=0, resume="auto")
+    mgr = model._ckpt_manager
+    assert mgr is not None and mgr._snapshot_steps()
+    assert mgr.global_step == 4  # 16 samples / batch 4, 1 epoch
+    w_after = np.asarray(model.network.state_dict()["weight"])
+
+    # relaunch analog: fresh process-state model, same env contract.
+    # epochs=1 is already complete -> pure restore, zero train steps
+    model2, loader2 = _tiny_fit_parts()
+    model2.fit(loader2, epochs=1, verbose=0, resume="auto")
+    np.testing.assert_array_equal(
+        np.asarray(model2.network.state_dict()["weight"]), w_after)
+
+    # a longer fit continues training from the restored boundary
+    model3, loader3 = _tiny_fit_parts()
+    model3.fit(loader3, epochs=2, verbose=0, resume="auto")
+    assert model3._ckpt_manager.global_step == 8
+
+
+# ---------------------------------------------------------------------------
+# the acceptance demo: SIGKILL mid-fit, relaunch, bit-identical losses
+# ---------------------------------------------------------------------------
+
+WORKER = os.path.join(REPO, "tests", "elastic_worker_fit.py")
+
+
+def _worker_env(tmp_path, log_name, stall_at=None, epochs=3):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            del env[k]
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PADDLE_CKPT_DIR"] = str(tmp_path / "ckpt_root")
+    env["PADDLE_JOB_ID"] = "sigkill_job"
+    env["PADDLE_CKPT_SAVE_STEPS"] = "1"
+    env["PADDLE_FLIGHT_DIR"] = str(tmp_path / "flight")
+    env["ELASTIC_LOSS_LOG"] = str(tmp_path / log_name)
+    env["ELASTIC_EPOCHS"] = str(epochs)
+    if stall_at is not None:
+        env["ELASTIC_STALL_AT"] = str(stall_at)
+    return env
+
+
+def _parse_log(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            g, h = line.split()
+            out[int(g)] = h
+    return out
+
+
+@pytest.mark.timeout(600)
+def test_sigkill_mid_fit_resume_bit_identical(tmp_path):
+    """kill -9 mid-fit, relaunch with the same PADDLE_JOB_ID ->
+    training resumes BIT-identically (same losses step-for-step as an
+    uninterrupted run): params+opt slots+rng+lr schedule+data cursor
+    all round-trip through the async snapshots."""
+    stall_at = 8  # mid-epoch-1 (3 epochs x 6 steps)
+
+    # uninterrupted reference run
+    ref = subprocess.run(
+        [sys.executable, WORKER],
+        env=_worker_env(tmp_path, "ref.log"),
+        capture_output=True, timeout=240)
+    assert ref.returncode == 0, ref.stderr.decode()[-3000:]
+    ref_losses = _parse_log(tmp_path / "ref.log")
+    assert sorted(ref_losses) == list(range(18))
+
+    # interrupted run: parks after logging step `stall_at`, then
+    # SIGKILL once its checkpoint is durable on disk
+    env = _worker_env(tmp_path / "run2", "victim.log",
+                      stall_at=stall_at)
+    env["PADDLE_CKPT_DIR"] = str(tmp_path / "run2_ckpt")
+    (tmp_path / "run2").mkdir()
+    victim = subprocess.Popen([sys.executable, WORKER], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+    manifest = os.path.join(
+        str(tmp_path / "run2_ckpt"), "sigkill_job", "train_state",
+        f"step_{stall_at}", "manifest.json")
+    log_path = tmp_path / "run2" / "victim.log"
+    deadline = time.monotonic() + 240
+    try:
+        while time.monotonic() < deadline:
+            if victim.poll() is not None:
+                out = victim.stdout.read().decode(errors="replace")
+                pytest.fail(f"worker exited early:\n{out[-3000:]}")
+            if (os.path.exists(manifest)
+                    and log_path.exists()
+                    and stall_at in _parse_log(log_path)):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("worker never reached the stall point")
+        victim.kill()  # SIGKILL: no cleanup, no final flush
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        victim.wait(30)
+        victim.stdout.close()
+    part1 = _parse_log(log_path)
+    assert max(part1) == stall_at
+
+    # relaunch with the same PADDLE_JOB_ID — resumes and completes
+    env2 = _worker_env(tmp_path / "run2", "resumed.log")
+    env2["PADDLE_CKPT_DIR"] = str(tmp_path / "run2_ckpt")
+    resumed = subprocess.run([sys.executable, WORKER], env=env2,
+                             capture_output=True, timeout=240)
+    assert resumed.returncode == 0, resumed.stderr.decode()[-3000:]
+    part2 = _parse_log(tmp_path / "run2" / "resumed.log")
+
+    # the resumed run replays from the last durable boundary
+    assert min(part2) == stall_at
+    assert sorted(set(part1) | set(part2)) == list(range(18))
+    # overlap (the step whose checkpoint the kill interrupted) must
+    # reproduce bit-for-bit from the snapshot
+    for g in set(part1) & set(part2):
+        assert part1[g] == part2[g], f"step {g} diverged on resume"
+    # and the stitched run equals the uninterrupted one, bit-for-bit
+    stitched = dict(part1)
+    stitched.update(part2)
+    assert stitched == ref_losses

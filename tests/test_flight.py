@@ -712,6 +712,10 @@ def test_monitor_env_vars_documented_in_readme():
     files += glob.glob(os.path.join(REPO, "paddle_tpu", "hapi", "*.py"))
     files += glob.glob(
         os.path.join(REPO, "paddle_tpu", "device", "*.py"))
+    # elastic checkpointing (the PADDLE_CKPT_* / EDL env contract)
+    files += glob.glob(
+        os.path.join(REPO, "paddle_tpu", "incubate", "checkpoint",
+                     "*.py"))
     assert files, "monitor sources not found"
     pat = re.compile(r"PADDLE_[A-Z0-9_]+")
     used = set()
