@@ -624,6 +624,18 @@ def main():
         results["ckpt"] = {
             k: v for k, v in results["telemetry"]["stats"].items()
             if k.startswith("ckpt/")}
+        # chaos/resilience provenance (ISSUE 7): chaos/* proves the
+        # run was fault-free (or names exactly what was injected),
+        # and comm/retries + train/nonfinite_* + io/workers/* +
+        # io/bad_samples + amp/scale/* record what the self-healing
+        # layers absorbed — a perf number with hidden retries or
+        # skipped steps is not a clean perf number
+        results["resilience"] = {
+            k: v for k, v in results["telemetry"]["stats"].items()
+            if k.startswith(("chaos/", "io/workers/", "amp/scale/"))
+            or k in ("comm/retries", "io/bad_samples",
+                     "train/nonfinite_skips",
+                     "train/nonfinite_stops")}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

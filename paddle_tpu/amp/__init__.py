@@ -14,6 +14,7 @@ import threading
 import numpy as np
 import jax.numpy as jnp
 
+from ..core import monitor as _monitor
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from ..core.engine import no_grad
@@ -219,13 +220,32 @@ class GradScaler:
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                # scale-event accounting: a run's snapshot shows how
+                # often dynamic scaling backed off (non-finite grads)
+                # vs grew — bench embeds these with chaos/* so an
+                # unstable run is visible in the perf record
+                _monitor.stat_add("amp/scale/backoffs", 1)
         else:
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+                _monitor.stat_add("amp/scale/growths", 1)
         self._found_inf = False
+
+    def _record_step(self, found_inf):
+        """Compiled-path hook (jit.TrainStepCompiler(grad_scaler=...)):
+        the fused step already unscaled the grads and decided the
+        apply/skip inside the program — this applies ONE microstep's
+        finite/non-finite verdict to the dynamic-scale streak
+        accounting (backoff/growth), without the eager unscale_
+        pass."""
+        if not self._enable:
+            return
+        self._found_inf = bool(found_inf)
+        self._already_unscaled = False
+        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()

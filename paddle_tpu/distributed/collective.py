@@ -32,6 +32,7 @@ from jax import lax
 from .. import profiler as _profiler
 from ..core import monitor as _monitor
 from ..core.engine import apply_op, in_trace_mode
+from ..monitor import chaos as _chaos
 from ..monitor import flight as _flight
 from ..core.tensor import Tensor
 from . import mesh as mesh_mod
@@ -145,6 +146,13 @@ def _instrumented(op):
             try:
                 with _profiler.RecordEvent(f"comm/{op}",
                                            "Communication"):
+                    # chaos site "collective" sits INSIDE the flight
+                    # in-flight span, so an injected stall is exactly
+                    # what the watchdog sees for a real wedged
+                    # collective (and an injected raise rides the
+                    # same finally-cleanup path)
+                    if _chaos._armed:
+                        _chaos.hit("collective", op=op)
                     out = fn(*args, **kwargs)
             finally:
                 # the flight exit must fire even when the collective

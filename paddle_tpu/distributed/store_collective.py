@@ -25,15 +25,65 @@ from __future__ import annotations
 
 import base64
 import os
+import random as _random
 import time
 
 import numpy as np
+
+from ..core import monitor as _cmon
+from ..monitor import chaos as _chaos
 
 __all__ = ["StoreGroupComm", "get_store", "host_store_if_rank0",
            "store_endpoint"]
 
 _TTL = 300.0  # seconds a round's keys stay readable
-_POLL = 0.005
+_POLL = 0.005  # backoff FLOOR (was the fixed poll interval)
+
+
+# PRIVATE rng for backoff jitter: drawing from the global `random`
+# stream would consume a timing-dependent number of draws per retry
+# and silently desync any user code that seeded random.seed() for
+# reproducibility (this repo's elastic contract is bit-identical
+# replay)
+_jitter_rng = _random.Random()
+
+
+class _Backoff:
+    """Capped exponential backoff with jitter for store/rendezvous
+    polls — replaces the old tight fixed-interval sleeps. Sleeps
+    beyond the first couple of polls count under comm/retries, so a
+    run's snapshot shows how much self-healing the comm layer
+    ABSORBED (peers landing a few ms apart are normal operation, not
+    retries — counting them would drown the fault signal bench's
+    resilience record keys on); jitter (±25%) keeps a
+    whole group's members from re-polling the single-threaded store
+    in lockstep after a shared stall."""
+
+    def __init__(self, base=_POLL, cap=0.25):
+        self.base = float(base)
+        self.cap = float(cap)
+        self.attempts = 0
+
+    def next_delay(self):
+        d = min(self.cap, self.base * (1 << min(self.attempts, 16)))
+        return d * (0.75 + 0.5 * _jitter_rng.random())
+
+    _FREE_POLLS = 2  # ordinary peer skew, not self-healing
+
+    def note_attempt(self):
+        self.attempts += 1
+        if self.attempts > self._FREE_POLLS:
+            _cmon.stat_add("comm/retries", 1)
+
+    def sleep(self, deadline=None):
+        """One backoff sleep (clipped to `deadline`, a monotonic
+        reading)."""
+        d = self.next_delay()
+        if deadline is not None:
+            d = min(d, max(0.0, deadline - time.monotonic()))
+        self.note_attempt()
+        if d > 0:
+            time.sleep(d)
 
 _store_server = [None]
 _store_client = [None]
@@ -69,11 +119,17 @@ def host_store_if_rank0():
 
 
 def get_store(timeout=120.0):
-    """Connect (cached) to the store; rank 0 hosts it on first use."""
+    """Connect (cached) to the store; rank 0 hosts it on first use.
+    Connect attempts back off exponentially with jitter (bounded by
+    `timeout`) — a store that comes up seconds after its peers (the
+    common elastic-relaunch race) is absorbed instead of hammered at
+    a fixed 50ms cadence."""
     from .fleet.elastic import KVClient
 
     if _store_client[0] is not None:
         return _store_client[0]
+    if _chaos._armed:
+        _chaos.hit("rendezvous")
     host_store_if_rank0()
     ep = store_endpoint()
     if ep is None:
@@ -81,9 +137,11 @@ def get_store(timeout=120.0):
             "eager subgroup collectives need the TCP store endpoint — "
             "set PADDLE_TRAINER_ENDPOINTS (paddle.distributed.launch "
             "does) or PADDLE_STORE_ENDPOINT")
-    deadline = time.time() + timeout
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    bo = _Backoff(base=0.05, cap=1.0)
     last = None
-    while time.time() < deadline:
+    while True:
         try:
             c = KVClient(ep)
             c.list("__ping__")  # probe
@@ -91,8 +149,13 @@ def get_store(timeout=120.0):
             return c
         except OSError as e:
             last = e
-            time.sleep(0.05)
-    raise RuntimeError(f"cannot reach collective store at {ep}: {last}")
+        if time.monotonic() >= deadline:
+            break
+        bo.sleep(deadline)
+    raise RuntimeError(
+        f"cannot reach collective store at {ep} after "
+        f"{time.monotonic() - t0:.1f}s ({bo.attempts} connect "
+        f"attempts): {last}")
 
 
 def _enc(arr):
@@ -156,7 +219,7 @@ class StoreGroupComm:
         # publish this rank's data-plane endpoint so peers can stream
         # tensors directly (senders look it up once and cache)
         self._dp = get_dataplane()
-        self._store.put(f"dp/{self.rank}", self._dp.endpoint, ttl=0)
+        self._put(f"dp/{self.rank}", self._dp.endpoint, ttl=0)
         self._dp_peers = {}
 
     def _peer_endpoint(self, r, timeout=60.0):
@@ -171,15 +234,28 @@ class StoreGroupComm:
         return f"coll/{self.tag}/{kind}{seq}/{who}"
 
     def _wait_get(self, key, timeout):
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        if _chaos._armed:
+            _chaos.hit("store_get", key=key)
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        bo = _Backoff()
+        while True:
             v = self._store.get(key)
             if v is not None:
                 return v
-            time.sleep(_POLL)
+            if time.monotonic() >= deadline:
+                break
+            bo.sleep(deadline)
         raise TimeoutError(
             f"collective timeout waiting for {key} in group "
-            f"{self.ranks} — is every member calling the collective?")
+            f"{self.ranks} after {time.monotonic() - t0:.1f}s "
+            f"({bo.attempts} polls, capped-backoff) — is every member "
+            "calling the collective?")
+
+    def _put(self, key, val, ttl):
+        if _chaos._armed:
+            _chaos.hit("store_put", key=key)
+        self._store.put(key, val, ttl=ttl)
 
     def _exchange(self, arr, timeout):
         """Contribute my array, collect everyone's (by group order).
@@ -201,7 +277,7 @@ class StoreGroupComm:
                            else self._dp.recv(r, tag, seq,
                                               timeout=timeout))
             return out
-        self._store.put(self._key(seq, self.rank), _enc(arr), ttl=_TTL)
+        self._put(self._key(seq, self.rank), _enc(arr), ttl=_TTL)
         out = []
         for r in self.ranks:
             if r == self.rank:
@@ -242,7 +318,7 @@ class StoreGroupComm:
                 return arr
             return self._dp.recv(int(src), tag, seq, timeout=timeout)
         if self.rank == int(src):
-            self._store.put(self._key(seq, "b"), _enc(arr), ttl=_TTL)
+            self._put(self._key(seq, "b"), _enc(arr), ttl=_TTL)
             return arr
         return _dec(self._wait_get(self._key(seq, "b"), timeout))
 
@@ -254,8 +330,8 @@ class StoreGroupComm:
         still reading its barrier keys."""
         seq = self._seq
         self._exchange(np.zeros((), np.int8), timeout)
-        self._store.put(self._key(seq, self.rank, kind="d"), 1,
-                        ttl=_TTL)
+        self._put(self._key(seq, self.rank, kind="d"), 1,
+                  ttl=_TTL)
         if self.rank == self.ranks[0]:
             for r in self.ranks:
                 self._wait_get(self._key(seq, r, kind="d"), timeout)
@@ -276,16 +352,23 @@ class StoreGroupComm:
             self._dp.send(self._peer_endpoint(int(dst), timeout),
                           self.rank, f"p/{self.tag}", n, arr)
             return
-        self._store.put(k + f"/{n}", _enc(arr), ttl=3600.0)
+        self._put(k + f"/{n}", _enc(arr), ttl=3600.0)
 
     def recv(self, src, timeout=180.0):
+        if _chaos._armed:
+            _chaos.hit("store_get", key=f"p2p/{self.tag}")
         k = f"p2p/{self.tag}/{int(src)}->{self.rank}"
         if not hasattr(self, "_rcv"):
             self._rcv = {}
         n = self._rcv.get(k, 0)
         # the edge's transport is decided by the SENDER per message:
-        # poll both the store key and the data-plane inbox for seq n
-        deadline = time.time() + timeout
+        # poll both the store key and the data-plane inbox for seq n.
+        # The data-plane recv's own wait doubles as the backoff sleep
+        # (its timeout grows with the attempt count), so an idle edge
+        # is polled gently instead of at a tight fixed interval.
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        bo = _Backoff(base=_POLL * 4)
         while True:
             v = self._store.get(k + f"/{n}")
             if v is not None:
@@ -294,12 +377,14 @@ class StoreGroupComm:
                 return _dec(v)
             try:
                 val = self._dp.recv(int(src), f"p/{self.tag}", n,
-                                    timeout=_POLL * 4)
+                                    timeout=bo.next_delay())
                 self._rcv[k] = n + 1
                 return val
             except TimeoutError:
-                pass
-            if time.time() > deadline:
+                bo.note_attempt()
+            if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"p2p recv timeout: {k} seq {n} (store and "
-                    "data plane both empty)")
+                    f"p2p recv timeout: {k} seq {n} in group "
+                    f"{self.ranks} after "
+                    f"{time.monotonic() - t0:.1f}s ({bo.attempts} "
+                    "retries; store and data plane both empty)")

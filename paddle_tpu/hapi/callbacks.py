@@ -195,12 +195,14 @@ class ModelCheckpoint(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         live = getattr(self.model, "_ckpt_manager", None)
-        if live is not None and live.preempted.is_set():
-            # the preemption break leaves this epoch INCOMPLETE — an
-            # {epoch}.pdparams of a half-trained epoch would look
-            # like (and via rotation could displace) a real one; the
+        if (live is not None and live.preempted.is_set()) \
+                or getattr(self.model, "_nonfinite_stopped", False):
+            # a preemption or terminate_on_nan break leaves this
+            # epoch INCOMPLETE — an {epoch}.pdparams of a
+            # half-trained (possibly diverged) epoch would look like
+            # (and via rotation could displace) a real one; the
             # boundary training-state snapshot was already written
-            # synchronously by on_train_batch_end
+            # by on_train_batch_end / the nonfinite emergency save
             return
         if self.save_dir and (epoch + 1) % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/{epoch}")

@@ -56,6 +56,9 @@ class Model:
         self._fit_accum = 1     # fit(accumulate_grad_batches=...)
         self._accum_seen = 0    # dygraph-fallback accumulation counter
         self._fused_disabled = False  # a fused dispatch failed: latch
+        self._guard_nonfinite = False  # fit(guard_nonfinite=) latch
+        self._nan_streak = 0   # consecutive non-finite losses (fit)
+        self._nonfinite_stopped = False  # terminate_on_nan tripped
         self._ckpt_manager = None   # elastic CheckpointManager (fit)
         self._pending_opt_restore = None  # checkpointed opt state the
         # next fresh compiler preloads (restore_state)
@@ -89,14 +92,16 @@ class Model:
             comp = DistributedTrainStepCompiler(
                 self.network, self._optimizer, loss_fn, mesh=mesh,
                 steps_per_dispatch=steps_per_dispatch,
-                accumulate_steps=self._fit_accum)
+                accumulate_steps=self._fit_accum,
+                guard_nonfinite=self._guard_nonfinite)
         else:
             from ..jit import TrainStepCompiler
 
             comp = TrainStepCompiler(
                 self.network, self._optimizer, loss_fn,
                 steps_per_dispatch=steps_per_dispatch,
-                accumulate_steps=self._fit_accum)
+                accumulate_steps=self._fit_accum,
+                guard_nonfinite=self._guard_nonfinite)
         comp = self._adopt_stale(comp)
         pend = self._pending_opt_restore
         if pend is not None and comp._opt_state is None:
@@ -107,6 +112,31 @@ class Model:
             comp.restore_state(pend["slots"], pend["step"],
                                pend.get("accum"))
         return comp
+
+    @staticmethod
+    def _note_step_failure(e, recovered):
+        """A compiled-step failure that a fallback path SWALLOWS
+        (fused->K=1 demotion, compiled->eager) must not erase its
+        forensics: a RESOURCE_EXHAUSTED still writes the "oom" bundle
+        (census taken while the arrays are live) that the swallowed
+        raise would have produced, tagged with how the fit recovered.
+        Never raises."""
+        try:
+            if getattr(e, "_paddle_flight_dumped", False):
+                return
+            if not _memory.is_oom_error(e):
+                return
+            _flight.write_dump(
+                "oom", full_memory=True,
+                extra={"exception": {"type": type(e).__name__,
+                                     "message": str(e)[:500]},
+                       "recovered": recovered})
+            try:
+                e._paddle_flight_dumped = True
+            except Exception:
+                pass
+        except Exception:
+            pass
 
     def _adopt_stale(self, comp):
         """A retired compiler (e.g. stashed at the end of an
@@ -142,18 +172,33 @@ class Model:
             try:
                 loss = self._compiled_step(*avals)
                 return [float(loss.item())]
-            except Exception:
+            except Exception as e:
+                self._note_step_failure(e, "compiled_demoted_to_eager")
                 self._compiled_step = False
         return self._train_batch_eager(inputs, labels, update)
 
     def _train_batch_eager(self, inputs, labels, update):
-        """Dygraph tape fallback (lists already normalized)."""
+        """Dygraph tape fallback (lists already normalized). The
+        non-finite guard survives demotion to this path: a compiled
+        step failing once must not silently strip the protection
+        fit(guard_nonfinite=True) promised — at each apply boundary a
+        non-finite loss/grad skips the optimizer step and discards
+        the (tainted) window, counted like the compiled guard."""
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         if update:
             loss.backward()
             self._accum_seen += 1
             if self._accum_seen % self._fit_accum == 0:
+                if self._guard_nonfinite \
+                        and self._eager_nonfinite(loss):
+                    from ..core import monitor as _cmon
+
+                    _cmon.stat_add("train/nonfinite_skips", 1)
+                    _flight.record("nonfinite_skip", steps=1,
+                                   path="eager")
+                    self._optimizer.clear_grad()
+                    return [float(loss.item())]
                 if self._fit_accum > 1:
                     # tape grads summed over the window: average them
                     # to match the compiled path's gradient merge
@@ -166,6 +211,23 @@ class Model:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
         return [float(loss.item())]
+
+    def _eager_nonfinite(self, loss):
+        """Eager-path trip check (loss + every tape grad). One device
+        sync per apply — the eager path is already per-op dispatch, so
+        the guard's cost is noise here."""
+        import math
+
+        import jax.numpy as jnp
+
+        if not math.isfinite(float(loss.item())):
+            return True
+        for p in self.network.parameters():
+            g = getattr(p, "_grad", None)
+            if g is not None and not bool(
+                    jnp.all(jnp.isfinite(g._value))):
+                return True
+        return False
 
     def _train_batch_fused(self, group):
         """One fused dispatch over a group of K buffered (inputs,
@@ -221,11 +283,15 @@ class Model:
                      for j in range(len(rows[0]))]
             losses = step(*avals)
             return [float(v) for v in np.asarray(losses._value)]
-        except Exception:
+        except Exception as e:
             # the fused program failed: demote to a K=1 compiled
             # sibling that ADOPTS its live optimizer state — one bad
             # dispatch must not silently fork the whole fit onto the
-            # eager path with fresh optimizer slots
+            # eager path with fresh optimizer slots. A
+            # RESOURCE_EXHAUSTED here still leaves its OOM bundle
+            # (the demotion to a ~K-times-smaller program is the
+            # recovery, not a reason to lose the evidence)
+            self._note_step_failure(e, "fused_demoted_to_k1")
             self._fused_disabled = True
             dead, self._compiled_step = self._compiled_step, False
             tail = self._tail_step
@@ -275,7 +341,9 @@ class Model:
                     loss = self._tail_step(*avals)
                     fused.adopt_state_from(self._tail_step)
                     return [float(loss.item())]
-                except Exception:
+                except Exception as e:
+                    self._note_step_failure(e,
+                                            "tail_demoted_to_eager")
                     self._tail_step = False
             # no usable sibling: eager directly — going back through
             # train_batch would re-route here forever (fused is live)
@@ -316,7 +384,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None,
-            steps_per_dispatch=None, resume=None):
+            steps_per_dispatch=None, resume=None, terminate_on_nan=None,
+            guard_nonfinite=None):
         """steps_per_dispatch=K>1 buffers K loader batches and runs
         them as ONE fused compiled dispatch (jit.TrainStepCompiler's
         lax.scan path) — per-batch callbacks still fire once per
@@ -341,7 +410,19 @@ class Model:
         SIGTERM preemption handler (checkpoint-then-stop) and the
         watchdog checkpoint-then-abort hook. For a deterministic
         resumed data order pass a DataLoader over a seeded
-        BatchSampler (or shuffle=False)."""
+        BatchSampler (or shuffle=False).
+
+        guard_nonfinite=True (default PADDLE_JIT_GUARD_NONFINITE)
+        compiles the step with the fused non-finite guard: a microstep
+        whose loss/grads trip skips the optimizer apply bit-
+        identically to never having run the batch (counted under
+        train/nonfinite_skips).
+
+        terminate_on_nan=K (True means 1) escalates K CONSECUTIVE
+        non-finite batch losses to checkpoint-then-stop: with an armed
+        elastic manager (resume=...) an emergency snapshot of the last
+        good boundary is written, then the fit stops — a diverged run
+        leaves a resumable state instead of grinding out NaNs."""
         # failure forensics: distributed fits (or PADDLE_FLIGHT_AUTOARM
         # =1) get the collective/compile watchdog + crash-bundle
         # excepthook armed before the first step
@@ -349,6 +430,24 @@ class Model:
         accum = max(1, int(accumulate_grad_batches))
         self._fit_accum = accum
         self._accum_seen = 0  # never inherit a partial eager window
+        if guard_nonfinite is None:
+            guard_nonfinite = _flight._env_on(
+                "PADDLE_JIT_GUARD_NONFINITE", default=False)
+        guard_nonfinite = bool(guard_nonfinite)
+        if guard_nonfinite != self._guard_nonfinite:
+            # the guard is baked into the compiled program: retire a
+            # live step of the other flavor; the next build ADOPTS its
+            # optimizer state (no restart — unlike the accum rebuild,
+            # the merge window semantics don't change)
+            self._guard_nonfinite = guard_nonfinite
+            live = self._compiled_step or self._tail_step
+            if live and self._stale_step is None:
+                self._stale_step = live
+            self._compiled_step = None
+            self._tail_step = None
+        nan_k = max(0, int(terminate_on_nan or 0))  # True -> 1
+        self._nan_streak = 0
+        self._nonfinite_stopped = False
         for attr in ("_compiled_step", "_tail_step"):
             step = getattr(self, attr)
             if step and getattr(step, "_accum_steps", 1) != accum:
@@ -497,6 +596,16 @@ class Model:
                                   {"loss": loss[0], "step": s2,
                                    "batch_size": b2})
                 iters_done += 1
+                if nan_k:
+                    import math
+
+                    if math.isfinite(loss[0]):
+                        self._nan_streak = 0
+                    else:
+                        self._nan_streak += 1
+                        if self._nan_streak >= nan_k \
+                                and not self.stop_training:
+                            self._escalate_nonfinite(mgr)
             pending.clear()
 
         try:
@@ -529,8 +638,13 @@ class Model:
                                 break
                     _flush_pending()  # ragged/short tail group
                     cbks.on_epoch_end(epoch, {"loss": loss[0]})
+                    # an ABORTED epoch (preemption OR terminate_on_nan)
+                    # is incomplete — evaluating it or rotating a
+                    # half-trained (possibly diverged) epoch save in
+                    # would be misleading at best
                     preempted = (mgr is not None
-                                 and mgr.preempted.is_set())
+                                 and mgr.preempted.is_set()) \
+                        or self._nonfinite_stopped
                     if eval_loader is not None and not preempted \
                             and (epoch + 1) % eval_freq == 0:
                         self.evaluate(eval_loader,
@@ -625,6 +739,34 @@ class Model:
         if (not reset_optimizer and self._optimizer is not None
                 and os.path.exists(opt_path)):
             self._optimizer.set_state_dict(framework.load(opt_path))
+
+    def _escalate_nonfinite(self, mgr):
+        """terminate_on_nan tripped: checkpoint-then-stop. With an
+        armed elastic manager the emergency save publishes the last
+        completed step boundary (the state provider the checkpoint
+        callback refreshes per batch — pre-divergence when the guard
+        was on, since tripped updates were skipped); then the fit
+        stops at this boundary either way."""
+        import warnings
+
+        from ..core import monitor as _cmon
+
+        _cmon.stat_add("train/nonfinite_stops", 1)
+        _flight.record("terminate_on_nan", streak=self._nan_streak)
+        step = None
+        if mgr is not None:
+            try:
+                step = mgr.emergency_save("nonfinite")
+            except Exception:
+                step = None
+        warnings.warn(
+            f"terminate_on_nan: {self._nan_streak} consecutive "
+            "non-finite losses — stopping training"
+            + (f" (emergency snapshot at step {step})"
+               if step is not None else ""), RuntimeWarning)
+        self._nonfinite_stopped = True  # suppresses the aborted
+        # epoch's eval/epoch-save (fit loop + ModelCheckpoint)
+        self.stop_training = True
 
     # -- elastic training state (incubate.checkpoint.elastic) -------------
     def _live_compiler(self):

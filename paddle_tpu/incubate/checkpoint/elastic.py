@@ -69,6 +69,7 @@ import jax
 
 from ...core import monitor as _cmon
 from ...core.tensor import Tensor
+from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
 from ...monitor.flight import _env_float, _env_int, _env_on
 
@@ -468,6 +469,22 @@ class CheckpointManager:
                 os.makedirs(d, exist_ok=True)
                 payload = pickle.dumps(
                     {"schema": SCHEMA, "state": host}, protocol=4)
+                # chaos site "ckpt_write": enospc/delay/stall enact
+                # inside hit(); "torn" comes back for us to enact —
+                # a PARTIAL rank file bypassing the atomic writer and
+                # no manifest, exactly what a crash mid-write on a
+                # non-atomic filesystem leaves (restore() must skip
+                # it and fall back to the previous snapshot)
+                if _chaos._armed:
+                    act = _chaos.hit("ckpt_write", step=g)
+                    if act is not None and act.fault == "torn":
+                        with open(os.path.join(
+                                d, f"state_rank{self.rank}.pd"),
+                                "wb") as fh:
+                            fh.write(payload[:max(1,
+                                                  len(payload) // 2)])
+                        raise OSError(
+                            "chaos: torn checkpoint write (injected)")
                 _atomic_write_bytes(
                     os.path.join(d, f"state_rank{self.rank}.pd"),
                     payload)

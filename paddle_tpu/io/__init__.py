@@ -9,6 +9,7 @@ operators/reader/buffered_reader.cc).
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import math
 import os
@@ -22,6 +23,12 @@ from .. import profiler as _profiler
 from ..core import monitor as _monitor
 from ..core.tensor import Tensor, to_tensor
 from ..monitor import flight as _flight
+
+# single-process analog of worker._SKIPPED: a batch whose every
+# sample failed under on_bad_sample="skip" — consumed, never yielded
+# (the chaos `io_fetch` site lives in worker._fetch_samples, which
+# both the mp worker loop and the in-process _fetch go through)
+_SKIPPED_BATCH = object()
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -538,7 +545,23 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, prefetch_to_device=None):
+                 persistent_workers=False, prefetch_to_device=None,
+                 on_bad_sample=None, worker_restarts=None):
+        """on_bad_sample: per-sample error policy — "raise" (default)
+        fails the epoch on the first bad record; "skip" drops the
+        sample, counts it under io/bad_samples, and keeps the epoch
+        alive (a fully-failed batch is dropped whole). Map-style
+        datasets only: an IterableDataset has no per-sample fetch to
+        retry around (a raise mid-iterator invalidates the stream),
+        so iterable pipelines keep fail-fast and "skip" warns.
+        Default from PADDLE_IO_ON_BAD_SAMPLE.
+
+        worker_restarts: how many times EACH mp worker may be
+        restarted after dying or wedging (fresh shm ring, outstanding
+        batches re-fed in order) before the epoch fails. Default
+        PADDLE_IO_WORKER_RESTARTS (2). A worker that is alive but
+        silent past PADDLE_IO_WORKER_TIMEOUT_S seconds counts as
+        wedged (0 = never, the default)."""
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn
@@ -549,9 +572,23 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
         self.prefetch_to_device = prefetch_to_device
+        if on_bad_sample not in (None, "raise", "skip"):
+            raise ValueError(
+                f"DataLoader: on_bad_sample={on_bad_sample!r} "
+                "(expected 'raise' or 'skip')")
+        self.on_bad_sample = on_bad_sample
+        self.worker_restarts = worker_restarts
         self._pf_orphans = []  # feeder threads outliving their epoch
         self._mp_loader = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode and self._bad_sample_policy() == "skip":
+            import warnings
+
+            warnings.warn(
+                "DataLoader: on_bad_sample='skip' has no effect on an "
+                "IterableDataset (no per-sample fetch to retry "
+                "around) — errors still fail the epoch",
+                RuntimeWarning)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = batch_sampler.batch_size
@@ -595,22 +632,62 @@ class DataLoader:
                 "with state")
         bs.set_state_dict(state.get("batch_sampler", state))
 
-    def _fetch(self, indices, to_device=True):
+    # -- fault policy (shared by the mp and in-process pipelines) ----
+    def _bad_sample_policy(self):
+        v = self.on_bad_sample
+        if v is None:
+            v = os.environ.get("PADDLE_IO_ON_BAD_SAMPLE") or "raise"
+            if str(v).lower() not in ("raise", "skip"):
+                # the ctor kwarg validates loudly — the env leg must
+                # not silently turn a typo ('drop', 'sip') into
+                # fail-fast, which is the exact incident the knob
+                # exists to prevent (warnings dedup per call site)
+                import warnings
+
+                warnings.warn(
+                    f"PADDLE_IO_ON_BAD_SAMPLE={v!r} is not "
+                    "'raise'|'skip' — falling back to 'raise'",
+                    RuntimeWarning)
+        return "skip" if str(v).lower() == "skip" else "raise"
+
+    def _worker_restart_budget(self):
+        n = self.worker_restarts
+        if n is None:
+            n = _flight._env_int("PADDLE_IO_WORKER_RESTARTS", 2)
+        return max(0, int(n))
+
+    def _fetch(self, indices, to_device=True, telemetry=True,
+               policy=None):
         # io telemetry: this runs on the CALLING thread — under the
         # threaded prefetcher that is the producer thread, whose spans
         # the process-wide recorder now captures (the thread-local
-        # recorder used to drop them)
-        with _profiler.RecordEvent("io/fetch_batch", "Dataloader"):
+        # recorder used to drop them). telemetry=False (the
+        # batch_size=None per-SAMPLE path) keeps the bad-sample
+        # policy + chaos site but skips the span/counters/flight
+        # event — a million-sample pass would otherwise flood the
+        # bounded flight ring and evict the step/collective evidence
+        # dump bundles exist to keep
+        from .worker import _fetch_samples, note_bad_samples
+
+        with _profiler.RecordEvent("io/fetch_batch", "Dataloader") \
+                if telemetry else contextlib.nullcontext():
             t0 = _time.perf_counter()
-            samples = [self.dataset[i] for i in indices]
+            samples, skipped, err = _fetch_samples(
+                self.dataset, indices, None,
+                policy or self._bad_sample_policy())
+            if skipped:
+                note_bad_samples(skipped, err)
+                if not samples:
+                    return _SKIPPED_BATCH
             collate = self.collate_fn or _np_collate
             batch = collate(samples)
             if self.collate_fn is None and to_device:
                 batch = _to_device(batch)
-        us = int((_time.perf_counter() - t0) * 1e6)
-        _monitor.stat_add("io/batches", 1)
-        _monitor.stat_add("io/fetch_us", us)
-        _flight.record("io_fetch", n=len(indices), us=us)
+        if telemetry:
+            us = int((_time.perf_counter() - t0) * 1e6)
+            _monitor.stat_add("io/batches", 1)
+            _monitor.stat_add("io/fetch_us", us)
+            _flight.record("io_fetch", n=len(indices), us=us)
         return batch
 
     def _iter_batches(self, to_device=True):
@@ -634,11 +711,29 @@ class DataLoader:
                 out = collate(batch)
                 yield out if self.collate_fn is not None else dev(out)
         elif self.batch_sampler is None:
+            # one sample per index. Default-collate samples route
+            # through _fetch so the bad-sample policy and the chaos
+            # io_fetch site apply like every other path; a custom
+            # collate_fn keeps this path's legacy contract exactly
+            # (_np_collate + device placement, collate_fn unused)
+            pol = self._bad_sample_policy()  # once, not per sample
             for i in range(len(self.dataset)):
-                yield dev(_np_collate([self.dataset[i]]))
+                if self.collate_fn is not None:
+                    yield dev(_np_collate([self.dataset[i]]))
+                    continue
+                batch = self._fetch([i], to_device=to_device,
+                                    telemetry=False, policy=pol)
+                if batch is _SKIPPED_BATCH:
+                    continue
+                yield batch
         else:
+            pol = self._bad_sample_policy()  # once, not per batch
             for indices in self.batch_sampler:
-                yield self._fetch(indices, to_device=to_device)
+                batch = self._fetch(indices, to_device=to_device,
+                                    policy=pol)
+                if batch is _SKIPPED_BATCH:
+                    continue  # every sample failed under "skip"
+                yield batch
 
     def _multiprocess_iter(self, to_device=True):
         from .worker import MultiprocessLoader
@@ -654,7 +749,11 @@ class DataLoader:
                 iterable_mode=self._iterable_mode,
                 batch_size=self.batch_size,
                 drop_last=self.drop_last,
-                default_collate=self.collate_fn is None)
+                default_collate=self.collate_fn is None,
+                on_bad_sample=self._bad_sample_policy(),
+                restarts=self._worker_restart_budget(),
+                wedge_timeout_s=_flight._env_float(
+                    "PADDLE_IO_WORKER_TIMEOUT_S", 0.0))
 
         try:
             if self.persistent_workers:

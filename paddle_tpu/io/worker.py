@@ -24,8 +24,42 @@ import threading
 
 import numpy as np
 
+from ..monitor import chaos as _chaos
+
 _EOF = b"\x00PDEOF"
 _ERR = b"\x00PDERR"
+# skip marker (on_bad_sample="skip"): pickle of (partial batch or
+# None, n_skipped, formatted traceback of the last failure) — the
+# trainer counts io/bad_samples and drops a fully-failed batch
+_SKP = b"\x00PDSKP"
+
+# run_epoch-internal sentinel: a fed index batch whose every sample
+# failed under the skip policy — consumed (the fed/popped accounting
+# must advance) but never yielded
+_SKIPPED = object()
+
+_bad_sample_logged = [False]
+
+
+def note_bad_samples(n, err, worker=None):
+    """Trainer-side accounting for skipped samples: counter + flight
+    event always, and the FIRST failure's traceback once at VLOG(0) —
+    on_bad_sample='skip' must not force an operator to rerun in
+    'raise' mode just to learn WHY records are failing."""
+    from ..core import monitor as _monitor
+    from ..monitor import flight as _flight
+
+    _monitor.stat_add("io/bad_samples", n)
+    _flight.record("io_bad_sample", n=n, worker=worker)
+    if err and not _bad_sample_logged[0]:
+        _bad_sample_logged[0] = True
+        try:
+            _monitor.VLOG(
+                0, "DataLoader on_bad_sample='skip' dropped a sample "
+                   "(io/bad_samples counts them); first failure:\n"
+                   + str(err))
+        except Exception:
+            pass
 
 # zero-copy frame: magic(8) meta_len(8) nbufs(8) [off(8) len(8)]*n
 # meta-pickle then 64B-aligned out-of-band buffers. Arrays deserialize
@@ -325,10 +359,41 @@ def get_worker_info():
     return _worker_info
 
 
+def _fetch_samples(dataset, indices, worker_id, on_bad_sample):
+    """Per-sample fetch with the chaos `io_fetch` site and the
+    per-sample error policy: "raise" keeps today's fail-the-epoch
+    behavior; "skip" drops the failing sample and reports (samples,
+    n_skipped, last traceback) so the trainer can count it instead of
+    killing the epoch on one corrupt record."""
+    skip = on_bad_sample == "skip"
+    out, skipped, err = [], 0, None
+    for i in indices:
+        try:
+            if _chaos._armed:
+                _chaos.hit("io_fetch", worker=worker_id)
+            out.append(dataset[i])
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            # tagged chaos exceptions are runtime-FAULT injection
+            # (raise/enospc/resource_exhausted, a downgraded crash),
+            # NOT bad records — the skip policy swallowing one would
+            # make the chaos/* triggered counters claim faults with
+            # no observable effect. ChaosBadSample IS the bad-record
+            # simulation and stays skippable.
+            if not skip or getattr(e, "_paddle_chaos_fault", False):
+                raise
+            skipped += 1
+            import traceback
+
+            err = traceback.format_exc()
+    return out, skipped, err
+
+
 def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                  slots, slot_bytes, index_queue, worker_init_fn,
                  iterable_mode, batch_size, drop_last, base_seed,
-                 default_collate=False):
+                 default_collate=False, on_bad_sample="raise"):
     """Runs in the child process: pull work, compute, push to the ring."""
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset,
@@ -380,11 +445,20 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
             if item == "QUIT":
                 break
             try:
-                samples = [dataset[i] for i in item]
+                samples, skipped, err = _fetch_samples(
+                    dataset, item, worker_id, on_bad_sample)
+                if skipped:
+                    # skip-and-count: the trainer must still see ONE
+                    # payload for this fed batch (ring order), so the
+                    # partial batch (or None when every sample failed)
+                    # rides a _SKP frame with the skip count
+                    batch = collate_fn(samples) if samples else None
+                    ring.push(_SKP + pickle.dumps(
+                        (batch, skipped, err), protocol=5))
                 # default collate + zero-copy: stack straight into the
                 # slot (one copy per sample total)
-                if not (default_collate and _zero_copy_enabled()
-                        and _try_push_stacked(ring, samples)):
+                elif not (default_collate and _zero_copy_enabled()
+                          and _try_push_stacked(ring, samples)):
                     _push_batch(ring, collate_fn(samples))
             except Exception as e:  # surface the error to the trainer
                 import traceback
@@ -396,12 +470,26 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
 
 
 class MultiprocessLoader:
-    """Trainer-side controller: W workers, W rings, ordered pops."""
+    """Trainer-side controller: W workers, W rings, ordered pops.
+
+    SUPERVISED (map-style pipelines): a worker that dies (OOM-killed,
+    chaos crash) or wedges past `wedge_timeout_s` is restarted up to
+    `restarts` times EACH (per-worker budgets — one crashy worker
+    can't starve the others') with a FRESH ring + index queue, and
+    every index batch it was fed but the trainer has not yet popped
+    is re-fed in
+    order off the per-worker fed-log — global batch order is preserved
+    by construction (pops still ride ring w for batch k == w mod W).
+    Iterable-mode shards have no replayable cursor and keep the
+    fail-fast raise. Counters: io/workers/{restarts,leaked},
+    io/bad_samples; flight events io_worker_restart / io_bad_sample."""
 
     def __init__(self, dataset, collate_fn, num_workers, prefetch_factor,
                  slot_mb, worker_init_fn, timeout, persistent,
                  iterable_mode=False, batch_size=1, drop_last=False,
-                 default_collate=False):
+                 default_collate=False, on_bad_sample="raise",
+                 restarts=2, wedge_timeout_s=0.0):
+        import collections
         import multiprocessing as mp
 
         self._mp = mp.get_context("fork")
@@ -409,30 +497,133 @@ class MultiprocessLoader:
         self.timeout_ms = int(timeout * 1000) if timeout else -1
         self.persistent = persistent
         self.iterable_mode = iterable_mode
-        slot_bytes = slot_mb * 1024 * 1024
+        self._slot_bytes = slot_mb * 1024 * 1024
         slots = max(2, prefetch_factor)
         self._slots = slots
         self._busy = False
-        base = f"/pdtpu_{os.getpid()}_{id(self)}"
+        self._base = f"/pdtpu_{os.getpid()}_{id(self)}"
         self.rings = []
         self.queues = []
         self.procs = []
         base_seed = np.random.randint(0, 2 ** 31 - 1)
+        # everything a respawn needs (the dataset/collate refs fork
+        # cleanly). base_seed is REUSED, which restores the
+        # predecessor's INITIAL np.random state — but the respawn
+        # resumes mid-stream, so draw-dependent __getitem__ transforms
+        # (augmentation) diverge from the fault-free run after a
+        # restart: recovery trades that corner of bit-identity for a
+        # finished epoch, and io_worker_restart events mark where
+        self._spawn = dict(
+            dataset=dataset, collate_fn=collate_fn,
+            worker_init_fn=worker_init_fn, batch_size=batch_size,
+            drop_last=drop_last, base_seed=base_seed,
+            default_collate=default_collate,
+            on_bad_sample=on_bad_sample)
+        # PER-WORKER restart budgets: one crashy worker must not
+        # starve the others' supervision (the docstring contract is
+        # "restarted up to `restarts` times" per worker)
+        self._restart_budget = [max(0, int(restarts))] * num_workers
+        self._wedge_ms = int(max(0.0, float(wedge_timeout_s)) * 1000)
+        self._ring_gen = [0] * num_workers
+        # per-worker index batches fed but not yet popped (map mode) —
+        # the refeed source on restart
+        self._fed_log = [collections.deque()
+                         for _ in range(num_workers)]
+        # rings replaced by a restart, kept MAPPED until the worker's
+        # next delivered batch (see _restart_worker)
+        self._retired_rings = [[] for _ in range(num_workers)]
+        self._done_feeding = False
+        # per-worker: did THIS epoch's end-of-epoch EOF already pop?
+        # (a restart must not replay the None marker then — the fresh
+        # worker's second EOF would surface as a garbage "batch" at
+        # the start of the NEXT persistent epoch)
+        self._eof_seen = [False] * num_workers
         for w in range(num_workers):
-            ring_name = f"{base}_{w}"
-            ring = ShmRing(ring_name, slots, slot_bytes, create=True)
-            q = self._mp.Queue()
-            p = self._mp.Process(
-                target=_worker_loop,
-                args=(w, num_workers, dataset, collate_fn, ring_name,
-                      slots, slot_bytes, q, worker_init_fn,
-                      iterable_mode, batch_size, drop_last, base_seed,
-                      default_collate),
-                daemon=True)
-            p.start()
+            ring, q, p = self._spawn_worker(w)
             self.rings.append(ring)
             self.queues.append(q)
             self.procs.append(p)
+
+    def _spawn_worker(self, w):
+        """Fork one worker on a fresh ring + queue (initial spawn and
+        restart share this path)."""
+        gen = self._ring_gen[w]
+        ring_name = (f"{self._base}_{w}" if gen == 0
+                     else f"{self._base}_{w}g{gen}")
+        ring = ShmRing(ring_name, self._slots, self._slot_bytes,
+                       create=True)
+        q = self._mp.Queue()
+        s = self._spawn
+        p = self._mp.Process(
+            target=_worker_loop,
+            args=(w, self.num_workers, s["dataset"], s["collate_fn"],
+                  ring_name, self._slots, self._slot_bytes, q,
+                  s["worker_init_fn"], self.iterable_mode,
+                  s["batch_size"], s["drop_last"], s["base_seed"],
+                  s["default_collate"], s["on_bad_sample"]),
+            daemon=True)
+        p.start()
+        return ring, q, p
+
+    @staticmethod
+    def _reap(p, grace=2.0):
+        """terminate -> kill escalation with bounded joins; returns
+        False when the process survived everything (leaked). ONE copy
+        shared by restart and shutdown so the escalation discipline
+        can't drift between them."""
+        try:
+            if not p.is_alive():
+                p.join(0.5)
+                return True
+            p.terminate()
+            p.join(grace)
+            if p.is_alive():
+                p.kill()  # SIGKILL: wedged in C code / a chaos stall
+                p.join(1.0)
+        except Exception:
+            pass
+        return not p.is_alive()
+
+    def _restart_worker(self, w, why):
+        """Replace a dead/wedged worker: kill what's left of it, drop
+        its ring (possibly holding a torn half-pushed batch), respawn
+        on a fresh ring, and re-feed its outstanding index batches in
+        their original order."""
+        from ..core import monitor as _monitor
+        from ..monitor import flight as _flight
+
+        self._restart_budget[w] -= 1
+        self._reap(self.procs[w])
+        # release the dead worker's queue (feeder thread + pipe fds):
+        # dropping the reference alone leaks them until GC, and a
+        # queue with unflushed items can block interpreter exit on
+        # the feeder join
+        try:
+            old_q = self.queues[w]
+            old_q.cancel_join_thread()
+            old_q.close()
+        except Exception:
+            pass
+        # do NOT close (munmap) the old ring yet: the last batch this
+        # worker delivered may be a zero-copy view still aliasing a
+        # slot — by contract it stays valid until the worker's NEXT
+        # pop, so the unmap is deferred to exactly that point (the
+        # new ring uses a fresh shm name, so no collision)
+        self._retired_rings[w].append(self.rings[w])
+        self._ring_gen[w] += 1
+        ring, q, proc = self._spawn_worker(w)
+        self.rings[w] = ring
+        self.queues[w] = q
+        self.procs[w] = proc
+        refed = list(self._fed_log[w])
+        for idxs in refed:
+            q.put(list(idxs))
+        if self._done_feeding and not self._eof_seen[w]:
+            q.put(None)  # replay the epoch-end marker too
+        _monitor.stat_add("io/workers/restarts", 1)
+        _flight.record("io_worker_restart", worker=w, why=why,
+                       refed=len(refed),
+                       restarts_left=self._restart_budget[w])
 
     def run_epoch(self, index_batches):
         """Feed indices round-robin with a bounded in-flight window;
@@ -455,28 +646,37 @@ class MultiprocessLoader:
             it = iter(index_batches)
             fed = popped = 0
             window = self.num_workers * self._slots
-            done_feeding = False
+            self._done_feeding = False
+            for d in self._fed_log:
+                d.clear()
+            self._eof_seen = [False] * self.num_workers
 
             def feed():
-                nonlocal fed, done_feeding
-                while not done_feeding and fed - popped < window:
+                nonlocal fed
+                while not self._done_feeding and fed - popped < window:
                     try:
                         idxs = next(it)
                     except StopIteration:
-                        done_feeding = True
+                        self._done_feeding = True
                         for q in self.queues:
                             q.put(None)  # epoch end marker
                         return
-                    self.queues[fed % self.num_workers].put(list(idxs))
+                    w = fed % self.num_workers
+                    idxs = list(idxs)
+                    self.queues[w].put(idxs)
+                    self._fed_log[w].append(idxs)
                     fed += 1
 
             feed()
             try:
-                while popped < fed or not done_feeding:
+                while popped < fed or not self._done_feeding:
                     batch = self._pop_checked(
-                        self.rings[popped % self.num_workers])
+                        popped % self.num_workers)
                     popped += 1
                     feed()
+                    if batch is _SKIPPED:
+                        continue  # every sample failed: drop, don't
+                        # yield (on_bad_sample="skip")
                     yield batch
             finally:
                 # early exit: flush remaining fed batches + all EOFs
@@ -484,16 +684,15 @@ class MultiprocessLoader:
                 # interpreter shutdown, where module globals the drain
                 # needs are already torn down)
                 if self.rings and not _sys.is_finalizing():
-                    if not done_feeding:
-                        done_feeding = True
+                    if not self._done_feeding:
+                        self._done_feeding = True
                         for q in self.queues:
                             q.put(None)
                     while popped < fed:
-                        self._pop_checked(
-                            self.rings[popped % self.num_workers])
+                        self._pop_checked(popped % self.num_workers)
                         popped += 1
-                    for r in self.rings:
-                        self._pop_checked(r)  # EOF markers
+                    for w in range(self.num_workers):
+                        self._pop_checked(w)  # EOF markers
         finally:
             self._busy = False
 
@@ -507,10 +706,10 @@ class MultiprocessLoader:
                 if w not in live:
                     w = (w + 1) % self.num_workers
                     continue
-                batch = self._pop_checked(self.rings[w])
+                batch = self._pop_checked(w)
                 if batch is _EOF:
                     live.discard(w)
-                else:
+                elif batch is not _SKIPPED:
                     yield batch
                 w = (w + 1) % self.num_workers
         finally:
@@ -519,22 +718,36 @@ class MultiprocessLoader:
             # interpreter shutdown)
             while live and self.rings and not _sys.is_finalizing():
                 for w in list(live):
-                    batch = self._pop_checked(self.rings[w])
+                    batch = self._pop_checked(w)
                     if batch is _EOF:
                         live.discard(w)
 
-    def _pop_checked(self, ring):
-        """Pop + decode with liveness polling: a worker killed by the
-        OS (or crashed outside the guarded region) must raise, not
-        hang. Returns the decoded batch, or the _EOF marker constant.
-        Zero-copy batches alias the ring slot; the slot is auto-
-        released on the NEXT pop of the same ring (pop_view), so a
-        yielded batch stays valid until that worker's next batch is
-        fetched — W batches of slack in the round-robin order."""
+    def _can_restart(self, w):
+        return not self.iterable_mode and self._restart_budget[w] > 0
+
+    def _pop_checked(self, w):
+        """Pop + decode worker `w`'s ring with liveness polling: a
+        worker killed by the OS (or crashed outside the guarded
+        region) is RESTARTED with its outstanding batches re-fed when
+        the supervision budget allows (map mode), else raises — never
+        hangs; a worker alive but silent past the wedge timeout
+        (PADDLE_IO_WORKER_TIMEOUT_S) is treated the same way. Returns
+        the decoded batch, the _EOF marker, or _SKIPPED (a fully
+        failed batch under on_bad_sample="skip"). Zero-copy batches
+        alias the ring slot; the slot is auto-released on the NEXT pop
+        of the same ring (pop_view), so a yielded batch stays valid
+        until that worker's next batch is fetched — W batches of slack
+        in the round-robin order."""
         import time as _t
 
+        from ..core import monitor as _monitor
+        from ..monitor import flight as _flight
+
         tick = 2000
-        waited = 0
+        if self._wedge_ms > 0:
+            tick = max(50, min(tick, self._wedge_ms // 2))
+        waited = 0        # total wait for THIS batch (user timeout)
+        wedge_waited = 0  # silence since last progress/restart
         t0 = _t.perf_counter()
         while True:
             if not self.procs:
@@ -542,44 +755,100 @@ class MultiprocessLoader:
                                    "batches were still pending")
             budget = (self.timeout_ms if self.timeout_ms > 0
                       else tick)
-            view = ring.pop_view(min(budget, tick))
+            view = self.rings[w].pop_view(min(budget, tick))
             if view is not None:
+                # this pop is the contract point where the worker's
+                # PREVIOUS batch becomes invalid — a pre-restart ring
+                # kept mapped for that batch can be unmapped now
+                for r in self._retired_rings[w]:
+                    try:
+                        r.close()
+                    except Exception:
+                        pass
+                self._retired_rings[w] = []
                 break
             waited += tick
+            wedge_waited += tick
+            # the user's per-batch timeout is TOTAL wait including
+            # any restarts — only the wedge timer resets on restart,
+            # or DataLoader(timeout=) would silently stretch to
+            # (restarts+1) x its bound
             if self.timeout_ms > 0 and waited >= self.timeout_ms:
                 self.shutdown()
                 raise RuntimeError(
                     f"DataLoader timed out after {self.timeout_ms} ms "
                     "waiting for a worker batch")
-            if any(not p.is_alive() for p in self.procs):
+            dead = [i for i, p in enumerate(self.procs)
+                    if not p.is_alive()]
+            wedged = (self._wedge_ms > 0
+                      and wedge_waited >= self._wedge_ms
+                      and w not in dead)
+            if dead and not self.iterable_mode \
+                    and all(self._restart_budget[i] > 0 for i in dead):
+                # restart every dead worker now (not just the one
+                # being popped) — their outstanding batches re-feed
+                # while this pop keeps waiting
+                for i in dead:
+                    self._restart_worker(i, why="died")
+                wedge_waited = 0
+                continue
+            if wedged and self._can_restart(w):
+                self._restart_worker(w, why="wedged")
+                wedge_waited = 0
+                continue
+            if dead or wedged:
                 self.shutdown()
                 raise RuntimeError(
-                    "a DataLoader worker process died unexpectedly "
-                    "(killed or crashed) — see worker logs")
+                    "a DataLoader worker process "
+                    + ("died unexpectedly (killed or crashed)"
+                       if dead else
+                       "wedged past PADDLE_IO_WORKER_TIMEOUT_S")
+                    + (" (iterable-mode pipelines are fail-fast by "
+                       "design)" if self.iterable_mode else
+                       " and its restart budget (worker_restarts) "
+                       "is exhausted")
+                    + " — see worker logs")
         # telemetry: ring-wait time (trainer blocked on workers) +
         # delivered payload bytes — io/ring_wait_us climbing while
         # step/time holds steady means the pipeline is input-bound
-        from ..core import monitor as _monitor
-
         _monitor.stat_add("io/ring_wait_us",
                           int((_t.perf_counter() - t0) * 1e6))
         _monitor.stat_add("io/ring_bytes", int(view.nbytes))
         batch = _decode_view(view)
         if batch is not None:
+            self._note_popped(w)
             return batch
         payload = bytes(view)
         view.release()
-        ring.release_view()
+        self.rings[w].release_view()
         if payload == _EOF:
+            self._eof_seen[w] = True
             return _EOF
         if payload.startswith(_ERR):
             name, tb = pickle.loads(payload[len(_ERR):])
             self.shutdown()
             raise RuntimeError(
                 f"DataLoader worker raised {name}:\n{tb}")
+        if payload.startswith(_SKP):
+            batch, nskip, err = pickle.loads(payload[len(_SKP):])
+            note_bad_samples(nskip, err, worker=w)
+            self._note_popped(w)
+            return _SKIPPED if batch is None else batch
+        self._note_popped(w)
         return pickle.loads(payload)
 
+    def _note_popped(self, w):
+        """One fed index batch of worker w was delivered — it leaves
+        the restart refeed window."""
+        if not self.iterable_mode and self._fed_log[w]:
+            self._fed_log[w].popleft()
+
     def shutdown(self):
+        """Tear the pool down with a BOUNDED grace window: QUIT, join,
+        escalate terminate -> kill, and COUNT any worker that survives
+        all of it under io/workers/leaked — teardown on an exception
+        mid-epoch must neither hang the trainer nor silently rely on
+        daemon reaping at interpreter exit."""
         for q in self.queues:
             try:
                 q.put("QUIT")
@@ -587,10 +856,29 @@ class MultiprocessLoader:
                 pass
         for p in self.procs:
             p.join(timeout=2)
-            if p.is_alive():
-                p.terminate()
+        leaked = sum(0 if self._reap(p, grace=1.0) else 1
+                     for p in self.procs)
+        if leaked:
+            from ..core import monitor as _monitor
+            from ..monitor import flight as _flight
+
+            _monitor.stat_add("io/workers/leaked", leaked)
+            _flight.record("io_worker_leak", n=leaked)
+        for q in self.queues:
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
         for r in self.rings:
             r.close()
+        for rs in self._retired_rings:
+            for r in rs:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+        self._retired_rings = [[] for _ in range(self.num_workers)]
         self.procs, self.queues, self.rings = [], [], []
 
     def __del__(self):

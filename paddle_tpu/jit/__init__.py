@@ -28,6 +28,7 @@ from .. import profiler as _profiler
 from ..core import engine
 from ..core import monitor as _monitor
 from ..core.tensor import Tensor
+from ..monitor import chaos as _chaos
 from ..monitor import flight as _flight
 from ..ops import random as _random
 from . import state as _jstate
@@ -751,7 +752,8 @@ class TrainStepCompiler:
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
                  accumulate_steps=1, amp_level=None, amp_dtype="bfloat16",
                  amp_custom_white_list=None, amp_custom_black_list=None,
-                 steps_per_dispatch=1):
+                 steps_per_dispatch=1, guard_nonfinite=False,
+                 grad_scaler=None):
         """accumulate_steps > 1 enables gradient merge (reference:
         fleet gradient_merge_optimizer / RecomputeOptimizer micro-batch
         accumulation): grads from k consecutive calls accumulate in a
@@ -767,7 +769,30 @@ class TrainStepCompiler:
         program; the learning rate is sampled ONCE per dispatch (the
         same value a sequential loop that doesn't call scheduler.step()
         between microsteps would see), and rng counters advance per
-        microstep so dropout/random streams match K separate calls."""
+        microstep so dropout/random streams match K separate calls.
+
+        guard_nonfinite=True fuses an all-finite predicate over the
+        loss and every gradient INTO the donated program (reference:
+        check_finite_and_unscale): a tripped microstep skips the
+        optimizer apply and passes params/opt-state/accumulators/
+        buffers through bit-identically to never having run the batch
+        (the non-finite loss is still returned so callers can see it).
+        Under gradient merge (accumulate_steps>1) a tripped microstep
+        instead contributes ZERO gradient to its window while the
+        accumulate/apply/zero cadence runs on schedule — skipping the
+        boundary would roll the window's grads into the next one and
+        double-weight it. Trips count under train/nonfinite_skips and
+        leave nonfinite_skip flight events. Reading the trip flags
+        costs one small device sync per dispatch.
+
+        grad_scaler=amp.GradScaler wires dynamic loss scaling through
+        the compiled step (reference update_loss_scaling): the live
+        scale rides in as a host scalar per dispatch (like lr — no
+        recompile on backoff/growth), the loss is scaled before the
+        backward and gradients unscale before the guard + apply, and
+        each microstep's finite/non-finite verdict drives the scaler's
+        backoff/growth accounting host-side. Implies
+        guard_nonfinite."""
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
@@ -778,6 +803,16 @@ class TrainStepCompiler:
         self._amp_black = amp_custom_black_list
         self._accum_steps = max(1, int(accumulate_steps))
         self._steps_per_dispatch = max(1, int(steps_per_dispatch))
+        # GradScaler(enable=False) is a no-op on the eager path —
+        # honor the same contract here (its _scale is still 2**16;
+        # baking it into the program would scale the loss AND force
+        # the guard on for a scaler the user explicitly disabled)
+        if grad_scaler is not None and not grad_scaler.is_enable():
+            grad_scaler = None
+        self._grad_scaler = grad_scaler
+        self._guard_nonfinite = bool(guard_nonfinite
+                                     or grad_scaler is not None)
+        self.last_skips = 0  # nonfinite trips in the last dispatch
         self._accum_state = None
         self._compiled = None
         self._names = None
@@ -823,7 +858,14 @@ class TrainStepCompiler:
         rngc = np.uint32(self._step)
         return self._compiled.lower(
             pvals, self._opt_state, self._accum_state, fvals, bvals,
-            avals, lr, rngc).compile()
+            avals, lr, rngc, self._loss_scale()).compile()
+
+    def _loss_scale(self):
+        """The host-scalar loss scale this dispatch runs at (1.0
+        without a grad scaler — the trace multiplies by it only when a
+        scaler is attached, so the plain program is untouched)."""
+        s = self._grad_scaler
+        return np.float32(s._scale if s is not None else 1.0)
 
     def _check_microbatch_axis(self, batch):
         """steps_per_dispatch=K expects every batch element stacked
@@ -925,6 +967,11 @@ class TrainStepCompiler:
             self._mem_analysis = None
 
     def _run_compiled(self, trainable, frozen, bufs, batch):
+        # chaos site "dispatch": a synthetic RESOURCE_EXHAUSTED here
+        # exercises the real OOM-forensics path (is_oom_error
+        # classifies by exception NAME + message)
+        if _chaos._armed:
+            _chaos.hit("dispatch", steps=self._steps_per_dispatch)
         pvals = {k: p._value for k, p in trainable.items()}
         fvals = {k: p._value for k, p in frozen.items()}
         bvals = {k: b._value for k, b in bufs.items()}
@@ -932,9 +979,9 @@ class TrainStepCompiler:
         # host scalars (jit globalizes them under any mesh/process set)
         lr = np.float32(self._opt.get_lr())
         rngc = np.uint32(self._step)
-        new_p, new_opt, new_acc, new_b, loss = self._compiled(
+        new_p, new_opt, new_acc, new_b, loss, skips = self._compiled(
             pvals, self._opt_state, self._accum_state, fvals, bvals,
-            avals, lr, rngc)
+            avals, lr, rngc, self._loss_scale())
         self._opt_state = new_opt
         self._accum_state = new_acc
         for k, p in trainable.items():
@@ -963,6 +1010,22 @@ class TrainStepCompiler:
         # `step % accum == 0` check)
         self._opt._step_count += (self._step // self._accum_steps
                                   - prev // self._accum_steps)
+        if self._guard_nonfinite:
+            # the ONLY host sync the guard adds: kd tiny flags. Per-
+            # microstep order matters to the scaler (a backoff between
+            # microsteps of one dispatch can't retro-scale them — the
+            # scale was sampled once, like lr — but the incr/decr
+            # streak accounting must still see every verdict).
+            flags = np.atleast_1d(np.asarray(skips))
+            n = int(flags.sum())
+            self.last_skips = n
+            if n:
+                _monitor.stat_add("train/nonfinite_skips", n)
+                _flight.record("nonfinite_skip", steps=n,
+                               dispatch_steps=kd)
+            if self._grad_scaler is not None:
+                for f in flags:
+                    self._grad_scaler._record_step(bool(f))
         # K>1 returns the K per-microstep losses (shape (K,))
         return Tensor(loss, stop_gradient=True, _internal=True)
 
@@ -1119,34 +1182,95 @@ class TrainStepCompiler:
 
         k_merge = self._accum_steps
         k_dispatch = self._steps_per_dispatch
+        guard = self._guard_nonfinite
+        use_scale = self._grad_scaler is not None
 
         def one_step(pvals, opt_state, accum, fvals, bvals, avals, lr,
-                     rngc):
-            (loss, new_bvals), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(pvals, fvals, bvals, avals, rngc)
-            if k_merge <= 1:
-                new_p, new_s = opt.apply_gradients(pvals, grads,
-                                                   opt_state, lr)
-                return new_p, new_s, accum, new_bvals, loss
-            # gradient merge: accumulate; apply every k-th call
-            acc = {n: accum[n] + grads[n].astype(jnp.float32)
-                   for n in grads}
+                     rngc, scale):
+            if use_scale:
+                # dynamic loss scaling (check_finite_and_unscale +
+                # update_loss_scaling, fused): backward runs on the
+                # SCALED loss, gradients unscale before guard/apply,
+                # the user-visible loss stays unscaled (aux)
+                def scaled_loss_of(pv, fv, bv, av, rc):
+                    loss, nb = loss_of(pv, fv, bv, av, rc)
+                    return loss * scale, (loss, nb)
 
-            def _apply(_):
-                merged = {n: (acc[n] / k_merge).astype(grads[n].dtype)
-                          for n in acc}
-                new_p, new_s = opt.apply_gradients(pvals, merged,
-                                                   opt_state, lr)
-                zeros = {n: jnp.zeros_like(acc[n]) for n in acc}
-                return new_p, new_s, zeros
+                (_, (loss, new_bvals)), grads = jax.value_and_grad(
+                    scaled_loss_of, has_aux=True)(pvals, fvals, bvals,
+                                                  avals, rngc)
+                inv = (np.float32(1.0) / scale)
+                grads = {n: (g.astype(jnp.float32) * inv).astype(
+                    g.dtype) for n, g in grads.items()}
+            else:
+                (loss, new_bvals), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(pvals, fvals, bvals, avals,
+                                           rngc)
 
-            def _skip(_):
-                return pvals, opt_state, acc
+            if guard:
+                # fused all-finite predicate over loss + every grad
+                # (check_finite_and_unscale)
+                ok = jnp.isfinite(loss)
+                for g in tree_util.tree_leaves(grads):
+                    ok = jnp.logical_and(ok,
+                                         jnp.all(jnp.isfinite(g)))
+                if k_merge > 1:
+                    # under gradient merge a whole-step cond
+                    # passthrough would also skip the BOUNDARY zeroing
+                    # — a trip on the k-th microstep would roll the
+                    # window's grads into the next one and silently
+                    # double-weight it. Instead the tripped microstep
+                    # contributes ZERO gradient (and keeps its old
+                    # buffers) while the accumulate/apply/zero cadence
+                    # runs on schedule — the reference's
+                    # check_finite_and_unscale zeroing semantics.
+                    grads = {n: jnp.where(ok, g, jnp.zeros_like(g))
+                             for n, g in grads.items()}
+                    new_bvals = {k: jnp.where(ok, v, bvals[k])
+                                 for k, v in new_bvals.items()}
 
-            do_apply = (rngc % np.uint32(k_merge)) == np.uint32(k_merge - 1)
-            new_p, new_s, new_acc = jax.lax.cond(do_apply, _apply, _skip,
-                                                 None)
-            return new_p, new_s, new_acc, new_bvals, loss
+            def _apply_all(_):
+                if k_merge <= 1:
+                    new_p, new_s = opt.apply_gradients(pvals, grads,
+                                                       opt_state, lr)
+                    return new_p, new_s, accum, new_bvals
+                # gradient merge: accumulate; apply every k-th call
+                acc = {n: accum[n] + grads[n].astype(jnp.float32)
+                       for n in grads}
+
+                def _apply(_):
+                    merged = {n: (acc[n] / k_merge).astype(
+                        grads[n].dtype) for n in acc}
+                    new_p, new_s = opt.apply_gradients(pvals, merged,
+                                                       opt_state, lr)
+                    zeros = {n: jnp.zeros_like(acc[n]) for n in acc}
+                    return new_p, new_s, zeros
+
+                def _skip(_):
+                    return pvals, opt_state, acc
+
+                do_apply = (rngc % np.uint32(k_merge)) \
+                    == np.uint32(k_merge - 1)
+                new_p, new_s, new_acc = jax.lax.cond(do_apply, _apply,
+                                                     _skip, None)
+                return new_p, new_s, new_acc, new_bvals
+
+            if guard and k_merge <= 1:
+                # no merge window: a trip skips the update AND the
+                # buffer commits — bit-identical to never having run
+                # the batch; only the (non-finite) loss escapes as
+                # evidence
+                def _passthrough(_):
+                    return pvals, opt_state, accum, bvals
+
+                new_p, new_s, new_acc, new_b = jax.lax.cond(
+                    ok, _apply_all, _passthrough, None)
+                skip = (~ok).astype(jnp.uint32)
+            else:
+                new_p, new_s, new_acc, new_b = _apply_all(None)
+                skip = ((~ok).astype(jnp.uint32) if guard
+                        else jnp.uint32(0))
+            return new_p, new_s, new_acc, new_b, loss, skip
 
         if k_dispatch <= 1:
             step_fn = one_step
@@ -1154,23 +1278,23 @@ class TrainStepCompiler:
             # fused multi-step dispatch: scan the SAME one_step body
             # over K stacked microbatches, carrying the donated
             # (params, opt_state, accum, buffers) entirely on device.
-            # frozen params and lr broadcast (closure); rng counters
-            # advance per microstep so random streams match K
-            # sequential dispatches bit-for-bit.
+            # frozen params, lr and the loss scale broadcast
+            # (closure); rng counters advance per microstep so random
+            # streams match K sequential dispatches bit-for-bit.
             def step_fn(pvals, opt_state, accum, fvals, bvals, avals,
-                        lr, rngc):
+                        lr, rngc, scale):
                 def body(carry, xs):
                     p, s, acc, bv = carry
                     av, rc = xs
-                    p, s, acc, bv, loss = one_step(p, s, acc, fvals,
-                                                   bv, av, lr, rc)
-                    return (p, s, acc, bv), loss
+                    p, s, acc, bv, loss, skip = one_step(
+                        p, s, acc, fvals, bv, av, lr, rc, scale)
+                    return (p, s, acc, bv), (loss, skip)
 
                 rcs = rngc + jnp.arange(k_dispatch, dtype=jnp.uint32)
-                (p, s, acc, bv), losses = jax.lax.scan(
+                (p, s, acc, bv), (losses, skips) = jax.lax.scan(
                     body, (pvals, opt_state, accum, bvals),
                     (avals, rcs))
-                return p, s, acc, bv, losses
+                return p, s, acc, bv, losses, skips
 
         self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
                                         batch)

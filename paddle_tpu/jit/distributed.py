@@ -60,7 +60,8 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                  batch_specs=None, donate=True, accumulate_steps=1,
                  amp_level=None, amp_dtype="bfloat16",
                  amp_custom_white_list=None, amp_custom_black_list=None,
-                 steps_per_dispatch=1):
+                 steps_per_dispatch=1, guard_nonfinite=False,
+                 grad_scaler=None):
         from ..distributed import mesh as mesh_mod
 
         super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate,
@@ -68,7 +69,9 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                          amp_level=amp_level, amp_dtype=amp_dtype,
                          amp_custom_white_list=amp_custom_white_list,
                          amp_custom_black_list=amp_custom_black_list,
-                         steps_per_dispatch=steps_per_dispatch)
+                         steps_per_dispatch=steps_per_dispatch,
+                         guard_nonfinite=guard_nonfinite,
+                         grad_scaler=grad_scaler)
         self._mesh = mesh or mesh_mod.default_mesh()
         mesh_mod.set_mesh(self._mesh)  # activation constraints read this
         self._batch_specs = batch_specs
@@ -182,11 +185,14 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         for i, b in enumerate(batch):
             v = b._value if isinstance(b, Tensor) else np.asarray(b)
             batch_sh.append(self._batch_sharding(i, np.ndim(v)))
+        # inputs: (params, slots, accum, frozen, buffers, batch, lr,
+        # rngc, loss_scale); outputs add the replicated per-microstep
+        # nonfinite-skip flags after the losses
         in_shardings = (param_sh, self._slot_shardings,
                         self._accum_shardings, frozen_sh, buf_sh,
-                        tuple(batch_sh), repl, repl)
+                        tuple(batch_sh), repl, repl, repl)
         out_shardings = (param_sh, self._slot_shardings,
-                        self._accum_shardings, buf_sh, repl)
+                        self._accum_shardings, buf_sh, repl, repl)
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)

@@ -36,6 +36,13 @@ tooling around them):
     when a slice wedges, crash/SIGUSR1 dump bundles, and the
     `python -m paddle_tpu.monitor` CLI (inspect / merge-traces /
     tail). See flight.py and the README "Failure forensics" section.
+
+  * chaos (submodule) — deterministic, seeded fault injection over
+    named runtime sites (collectives, store rendezvous, checkpoint
+    writes, DataLoader fetches, compiled dispatch), armed by the
+    PADDLE_CHAOS spec and observed through chaos/* counters + flight
+    events. See chaos.py and the README "Chaos testing & resilience"
+    section.
 """
 from __future__ import annotations
 
@@ -52,6 +59,7 @@ from ..core.monitor import (  # noqa: F401 — the counter surface
 )
 from . import flight  # noqa: E402 — the failure-forensics leg
 from . import memory  # noqa: E402 — the device-memory leg
+from . import chaos  # noqa: E402 — deterministic fault injection
 
 __all__ = [
     "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
@@ -59,6 +67,7 @@ __all__ = [
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
     "get_exporter", "telemetry_snapshot", "flight", "memory",
+    "chaos",
 ]
 
 
@@ -134,10 +143,16 @@ class StepTimer:
             # gauge kept float: int() would truncate big-model runs
             # under 1 sample/s to a stalled-looking 0
             stat_set("step/throughput", round(throughput, 3))
-        if loss is not None:
-            # micro-units: the registry holds ints (monitor.h int64)
+        import math
+
+        if loss is not None and math.isfinite(float(loss)):
+            # micro-units: the registry holds ints (monitor.h int64).
+            # A non-finite loss (diverged run, tripped guard) keeps
+            # the last finite gauge — int(nan) raises, and crashing
+            # the telemetry callback was exactly how a NaN loss used
+            # to kill the fit before terminate_on_nan could see it
             stat_set("step/last_loss_e6", int(float(loss) * 1e6))
-        if lr is not None:
+        if lr is not None and math.isfinite(float(lr)):
             stat_set("step/lr_e9", int(float(lr) * 1e9))
         # step-boundary memory tracking (PADDLE_MEM_STEP=0 disables —
         # on backends without PJRT stats each reading is a live-array

@@ -22,6 +22,12 @@ artifacts the runtime leaves behind:
       live-array census grouped by shape/dtype. Mostly useful
       in-process (cli.main(["memory"]) from a REPL/debug hook) —
       a fresh CLI process has no arrays of its own.
+
+  chaos [spec] [--json]
+      List the fault-injection sites/faults/params and validate a
+      PADDLE_CHAOS spec (the positional spec, else $PADDLE_CHAOS):
+      prints the parsed rules, or an `error: ...` + exit 2 on an
+      invalid spec — run it before launching a chaos job.
 """
 from __future__ import annotations
 
@@ -225,6 +231,60 @@ def cmd_memory(args):
         sys.stdout.write("\n")
         return 0
     print("\n".join(_memory_lines(report)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# chaos (site listing + spec validation)
+# ---------------------------------------------------------------------------
+
+def cmd_chaos(args):
+    from . import chaos as chaos_mod
+
+    spec = args.spec if args.spec is not None \
+        else os.environ.get("PADDLE_CHAOS", "")
+    parsed = None
+    if spec:
+        try:
+            parsed = chaos_mod.parse_spec(spec)
+        except ValueError as e:
+            print(f"error: invalid chaos spec: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        json.dump({"sites": chaos_mod.SITES,
+                   "faults": chaos_mod.FAULTS,
+                   "params": chaos_mod.PARAMS,
+                   "spec": spec or None,
+                   "rules": [r.describe() for r in parsed or []]},
+                  sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
+    out = ["chaos injection sites (PADDLE_CHAOS = "
+           "\"site:fault[:param=value]*[;...]\"):", ""]
+    w = max(len(s) for s in chaos_mod.SITES)
+    for s in sorted(chaos_mod.SITES):
+        out.append(f"  {s:<{w}s}  {chaos_mod.SITES[s]}")
+    out.append("")
+    out.append("faults:")
+    w = max(len(f) for f in chaos_mod.FAULTS)
+    for f in sorted(chaos_mod.FAULTS):
+        out.append(f"  {f:<{w}s}  {chaos_mod.FAULTS[f]}")
+    out.append("")
+    out.append("params:")
+    w = max(len(p) for p in chaos_mod.PARAMS)
+    for p in sorted(chaos_mod.PARAMS):
+        out.append(f"  {p:<{w}s}  {chaos_mod.PARAMS[p]}")
+    if parsed is not None:
+        out.append("")
+        out.append(f"spec OK — {len(parsed)} rule(s): {spec}")
+        for r in parsed:
+            d = r.describe()
+            extra = " ".join(
+                f"{k}={v}" for k, v in d.items()
+                if k not in ("site", "fault", "calls", "triggers")
+                and v is not None)
+            out.append(f"  {d['site']}:{d['fault']}  {extra}")
+    print("\n".join(out))
     return 0
 
 
@@ -434,6 +494,17 @@ def main(argv=None):
                       help="census groups to show "
                            "(default PADDLE_MEM_CENSUS_TOP_K)")
     pmem.set_defaults(fn=cmd_memory)
+
+    pch = sub.add_parser(
+        "chaos",
+        help="list fault-injection sites and validate a PADDLE_CHAOS "
+             "spec")
+    pch.add_argument("spec", nargs="?",
+                     help="spec to validate (default: $PADDLE_CHAOS)")
+    pch.add_argument("--json", action="store_true",
+                     help="emit sites/faults/params + parsed rules as "
+                          "JSON")
+    pch.set_defaults(fn=cmd_chaos)
 
     args = p.parse_args(argv)
     try:

@@ -10,7 +10,10 @@
 // Design: one ring per worker (single producer, single consumer), so
 // synchronization is two C11 atomics (head/tail) with acquire/release
 // ordering — no locks, no semaphores. Blocking ops spin with usleep
-// and honor a timeout.
+// and honor a timeout measured against CLOCK_MONOTONIC wall time —
+// counting usleep(200) as exactly 200us undercounts by the scheduler's
+// timer slack (observed ~5x), which turned a 2s liveness-poll tick
+// into ~11s of dead-worker detection latency.
 //
 // Build: compiled on demand by paddle_tpu.utils.cpp_extension.load()
 // (the PD_REGISTER_KERNEL-era custom-op toolchain analog).
@@ -20,12 +23,20 @@
 #include <cstdint>
 #include <cstring>
 
+#include <ctime>
+
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
+
+int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
 
 struct RingHeader {
   uint64_t slots;
@@ -103,7 +114,7 @@ int ring_push(void* handle, const uint8_t* buf, uint64_t len,
               int64_t timeout_ms) {
   Ring* r = reinterpret_cast<Ring*>(handle);
   if (len > r->hdr->slot_bytes) return -2;
-  int64_t waited_us = 0;
+  int64_t t0_us = now_us();
   for (;;) {
     uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
     uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
@@ -114,9 +125,9 @@ int ring_push(void* handle, const uint8_t* buf, uint64_t len,
       r->hdr->head.store(head + 1, std::memory_order_release);
       return 0;
     }
-    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return -1;
+    if (timeout_ms >= 0 && now_us() - t0_us >= timeout_ms * 1000)
+      return -1;
     usleep(200);
-    waited_us += 200;
   }
 }
 
@@ -124,7 +135,7 @@ int ring_push(void* handle, const uint8_t* buf, uint64_t len,
 int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
                  int64_t timeout_ms) {
   Ring* r = reinterpret_cast<Ring*>(handle);
-  int64_t waited_us = 0;
+  int64_t t0_us = now_us();
   for (;;) {
     uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
     uint64_t head = r->hdr->head.load(std::memory_order_acquire);
@@ -137,9 +148,9 @@ int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
       r->hdr->tail.store(tail + 1, std::memory_order_release);
       return (int64_t)len;
     }
-    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return -1;
+    if (timeout_ms >= 0 && now_us() - t0_us >= timeout_ms * 1000)
+      return -1;
     usleep(200);
-    waited_us += 200;
   }
 }
 
@@ -158,14 +169,14 @@ int64_t ring_pop(void* handle, uint8_t* buf, uint64_t buf_len,
 // timeout. Single producer: at most one reservation outstanding.
 uint8_t* ring_push_reserve(void* handle, int64_t timeout_ms) {
   Ring* r = reinterpret_cast<Ring*>(handle);
-  int64_t waited_us = 0;
+  int64_t t0_us = now_us();
   for (;;) {
     uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
     uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
     if (head - tail < r->hdr->slots) return slot_ptr(r, head) + kSlotHdr;
-    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
+    if (timeout_ms >= 0 && now_us() - t0_us >= timeout_ms * 1000)
+      return nullptr;
     usleep(200);
-    waited_us += 200;
   }
 }
 
@@ -185,7 +196,7 @@ int ring_push_commit(void* handle, uint64_t len) {
 uint8_t* ring_pop_view(void* handle, uint64_t* len_out,
                        int64_t timeout_ms) {
   Ring* r = reinterpret_cast<Ring*>(handle);
-  int64_t waited_us = 0;
+  int64_t t0_us = now_us();
   for (;;) {
     uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
     uint64_t head = r->hdr->head.load(std::memory_order_acquire);
@@ -194,9 +205,9 @@ uint8_t* ring_pop_view(void* handle, uint64_t* len_out,
       std::memcpy(len_out, p, 8);
       return p + kSlotHdr;
     }
-    if (timeout_ms >= 0 && waited_us >= timeout_ms * 1000) return nullptr;
+    if (timeout_ms >= 0 && now_us() - t0_us >= timeout_ms * 1000)
+      return nullptr;
     usleep(200);
-    waited_us += 200;
   }
 }
 

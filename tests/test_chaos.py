@@ -1,0 +1,834 @@
+"""Chaos-hardened runtime (paddle_tpu.monitor.chaos + the hardening it
+flushes out): deterministic seeded fault injection over named runtime
+sites, self-healing comm/data layers, and the non-finite step guards.
+
+The acceptance contracts exercised here:
+  * with nothing armed, every injection site is a zero-overhead no-op
+    behind the module-level flag;
+  * an injected stuck collective produces a watchdog dump bundle PLUS
+    a resumable emergency snapshot (PR 3 + PR 6 integration);
+  * an injected ckpt_write ENOSPC/torn write leaves the PREVIOUS
+    snapshot restorable;
+  * an injected worker crash restarts the worker (order preserved) or
+    fails fast without hanging teardown;
+  * a guard_nonfinite trip skips the update bit-identically to never
+    having run the batch — including under steps_per_dispatch>1.
+"""
+import glob
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.incubate.checkpoint.elastic import CheckpointManager
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.jit import TrainStepCompiler
+from paddle_tpu.monitor import chaos, flight
+from paddle_tpu.monitor.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Each test gets its own dump dir, a fresh ring, and a DISARMED
+    chaos layer on both sides."""
+    monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "dumps"))
+    chaos.disarm()
+    flight.recorder.clear()
+    yield
+    flight.stop_watchdog()
+    chaos.disarm()
+
+
+def _wait_for(pred, timeout=10.0, step=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class ArangeDS(Dataset):
+    """Deterministic (x, idx) pairs; optional bad indices."""
+
+    def __init__(self, n, bad=()):
+        self.n, self.bad = n, set(bad)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i in self.bad:
+            raise ValueError(f"corrupt record {i}")
+        return np.full((3,), i, np.float32), np.int64(i)
+
+
+def _mk_step(**kw):
+    paddle.seed(7)
+    net = nn.Linear(4, 3)
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=net.parameters())
+    step = TrainStepCompiler(
+        net, opt, lambda out, y: ((out - y) ** 2).mean(), **kw)
+    return net, opt, step
+
+
+_X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+_Y = np.random.RandomState(1).randn(8, 3).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / arming / determinism
+# ---------------------------------------------------------------------------
+
+def test_spec_parses_sites_faults_and_params():
+    rules = chaos.parse_spec(
+        "collective:stall:p=0.01:seed=7;ckpt_write:enospc:after=3")
+    assert [(r.site, r.fault) for r in rules] == [
+        ("collective", "stall"), ("ckpt_write", "enospc")]
+    assert rules[0].p == 0.01 and rules[0].seed == 7
+    assert rules[1].after == 3 and rules[1].p == 1.0
+    # hang aliases stall; empty segments tolerated
+    assert chaos.parse_spec("io_fetch:hang;")[0].fault == "stall"
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus:stall",             # unknown site
+    "collective:frob",         # unknown fault
+    "collective:stall:zz=1",   # unknown param
+    "collective:stall:p=2.0",  # p out of range
+    "collective:stall:p",      # not key=value
+    "collective",              # missing fault
+    "collective:raise:exc=SystemExit",  # unknown exc class
+    "io_fetch:torn",           # site-interpreted fault, wrong site
+    "collective:torn",
+])
+def test_spec_validation_rejects(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_sites_are_noops_when_disarmed():
+    assert not chaos._armed
+    assert chaos.hit("collective", op="all_reduce") is None
+    assert chaos.hit("ckpt_write") is None
+    assert not chaos.rules()
+
+
+def test_configure_from_env_and_disarm(monkeypatch):
+    monkeypatch.setenv("PADDLE_CHAOS", "collective:delay:ms=1")
+    rules = chaos.configure()
+    assert chaos._armed and len(rules) == 1
+    assert cmon.stat_get("chaos/armed") == 1
+    chaos.disarm()
+    assert not chaos._armed
+    assert cmon.stat_get("chaos/armed") == 0
+
+
+def test_seeded_probability_is_deterministic():
+    def pattern():
+        fired = []
+        with chaos.inject("collective", "delay", p=0.5, seed=42,
+                          ms=0.0) as r:
+            for _ in range(64):
+                chaos.hit("collective")
+                fired.append(r.triggers)
+        return fired
+
+    a, b = pattern(), pattern()
+    assert a == b
+    assert 0 < a[-1] < 64  # p=0.5 actually gates
+
+
+def test_after_every_times_discipline():
+    with chaos.inject("collective", "delay", after=3, every=2,
+                      times=2, ms=0.0) as r:
+        for _ in range(12):
+            chaos.hit("collective")
+        # calls 1-3 pass; eligible calls 4,6 trigger; times=2 caps
+        assert r.calls == 12 and r.triggers == 2
+
+
+def test_trigger_counts_and_flight_events():
+    n0 = cmon.stat_get("chaos/collective/delay/triggered")
+    with chaos.inject("collective", "delay", ms=1):
+        paddle.distributed.all_reduce(paddle.to_tensor([1.0]))
+    assert cmon.stat_get("chaos/collective/delay/triggered") == n0 + 1
+    evs = [e for e in flight.tail() if e["kind"] == "chaos_inject"]
+    assert evs and evs[-1]["site"] == "collective"
+    assert evs[-1]["op"] == "all_reduce"
+
+
+def test_collective_raise_rides_the_instrumented_cleanup():
+    with chaos.inject("collective", "raise"):
+        with pytest.raises(chaos.ChaosInjected):
+            paddle.distributed.all_reduce(paddle.to_tensor([1.0]))
+    # the in-flight entry must not leak (a leak would look like a
+    # permanent hang to the watchdog)
+    assert flight.inflight_snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_chaos_lists_sites(capsys):
+    assert cli_main(["chaos"]) == 0
+    out = capsys.readouterr().out
+    for site in chaos.SITES:
+        assert site in out
+    for fault in ("stall", "enospc", "bad_sample"):
+        assert fault in out
+
+
+def test_cli_chaos_validates_spec(capsys):
+    spec = "collective:stall:p=0.01:seed=7;ckpt_write:enospc:after=3"
+    assert cli_main(["chaos", spec]) == 0
+    assert "spec OK — 2 rule(s)" in capsys.readouterr().out
+    assert cli_main(["chaos", "bogus:stall"]) == 2
+    assert "error: invalid chaos spec" in capsys.readouterr().err
+
+
+def test_cli_chaos_json(capsys):
+    assert cli_main(["chaos", "--json", "io_fetch:crash:after=4"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["sites"]) == set(chaos.SITES)
+    assert doc["rules"][0]["site"] == "io_fetch"
+    assert doc["rules"][0]["after"] == 4
+
+
+# ---------------------------------------------------------------------------
+# stuck collective -> watchdog dump + emergency checkpoint (PR 3 + 6)
+# ---------------------------------------------------------------------------
+
+def test_stuck_collective_watchdog_dump_and_emergency_ckpt(tmp_path):
+    ck = str(tmp_path / "ck")
+    mgr = CheckpointManager(dir=ck, save_steps=1, async_write=False)
+    mgr.set_state_provider(
+        lambda: ({"model": {"w": np.arange(4.0)}},
+                 {"epoch": 0, "step_in_epoch": 3, "global_step": 3}))
+    flight.add_incident_hook(mgr._on_incident)
+    flight.start_watchdog(timeout_s=0.3, poll_s=0.05)
+    try:
+        with chaos.inject("collective", "stall", secs=2.5, times=1):
+            paddle.distributed.all_reduce(paddle.to_tensor([1.0]))
+    finally:
+        flight.stop_watchdog()
+        flight.remove_incident_hook(mgr._on_incident)
+    dumps = glob.glob(str(tmp_path / "dumps" / "watchdog_*.json"))
+    assert dumps, "watchdog did not dump during the injected stall"
+    with open(dumps[0]) as f:
+        bundle = json.load(f)
+    assert [(e["kind"], e["name"]) for e in bundle["stuck"]] == [
+        ("collective", "all_reduce")]
+    # the bundle shows WHAT was injected
+    assert any(e["kind"] == "chaos_inject"
+               for e in bundle["flight_tail"])
+    # ... and a RESUMABLE snapshot landed next to it
+    mgr2 = CheckpointManager(dir=ck)
+    state = mgr2.restore()
+    assert state is not None
+    assert np.array_equal(state["model"]["w"], np.arange(4.0))
+    assert mgr2.cursor == {"epoch": 0, "step_in_epoch": 3,
+                           "global_step": 3}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-write faults: previous snapshot stays restorable
+# ---------------------------------------------------------------------------
+
+def _mgr_state(v):
+    return {"model": {"w": np.full((4,), float(v))}}
+
+
+@pytest.mark.parametrize("fault", ["enospc", "torn"])
+def test_ckpt_write_fault_leaves_previous_snapshot_restorable(
+        tmp_path, fault):
+    ck = str(tmp_path / "ck")
+    mgr = CheckpointManager(dir=ck, save_steps=1, async_write=False)
+    mgr.save(_mgr_state(1), epoch=0, step_in_epoch=1, global_step=1)
+    e0 = cmon.stat_get("ckpt/errors")
+    with chaos.inject("ckpt_write", fault):
+        # sync-path save catches write errors (checkpoint-then-stop
+        # must not crash the fit) — the failure is COUNTED instead
+        mgr.save(_mgr_state(2), epoch=0, step_in_epoch=2,
+                 global_step=2)
+    assert cmon.stat_get("ckpt/errors") == e0 + 1
+    if fault == "torn":
+        # the torn write left a partial rank file without a manifest
+        torn = os.path.join(ck, "step_2", "state_rank0.pd")
+        assert os.path.exists(torn)
+        assert not os.path.exists(
+            os.path.join(ck, "step_2", "manifest.json"))
+    mgr2 = CheckpointManager(dir=ck)
+    state = mgr2.restore()
+    assert state is not None
+    assert np.array_equal(state["model"]["w"], np.full((4,), 1.0))
+    assert mgr2.cursor["global_step"] == 1
+
+
+def test_ckpt_write_enospc_after_n(tmp_path):
+    """The spec-string discipline end to end: after=2 lets two saves
+    through, then every later save fails."""
+    ck = str(tmp_path / "ck")
+    chaos.configure("ckpt_write:enospc:after=2")
+    try:
+        mgr = CheckpointManager(dir=ck, save_steps=1,
+                                async_write=False, max_num=5)
+        for g in (1, 2, 3):
+            mgr.save(_mgr_state(g), global_step=g)
+    finally:
+        chaos.disarm()
+    mgr2 = CheckpointManager(dir=ck)
+    mgr2.restore()
+    assert mgr2.cursor["global_step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# DataLoader: supervised workers + bad-sample policy + teardown
+# ---------------------------------------------------------------------------
+
+def test_worker_crash_restarts_and_preserves_order():
+    r0 = cmon.stat_get("io/workers/restarts")
+    # after=16, times=1: each forked worker (20 samples of the 40)
+    # crashes ONCE near the end of its share; the restarted worker
+    # has < 16 samples left so it cannot re-trip — 2 restarts total
+    with chaos.inject("io_fetch", "crash", after=16, times=1):
+        dl = DataLoader(ArangeDS(40), batch_size=2, num_workers=2,
+                        prefetch_to_device=0)
+        vals = []
+        for x, y in dl:
+            vals.extend(int(v) for v in np.asarray(y.numpy()))
+    assert vals == list(range(40))  # order preserved through refeed
+    assert cmon.stat_get("io/workers/restarts") == r0 + 2
+
+
+def test_worker_crash_without_restart_budget_fails_fast():
+    import multiprocessing as mp
+
+    t0 = time.monotonic()
+    with chaos.inject("io_fetch", "crash", after=4, times=1):
+        dl = DataLoader(ArangeDS(40), batch_size=2, num_workers=2,
+                        worker_restarts=0, prefetch_to_device=0)
+        with pytest.raises(RuntimeError, match="died unexpectedly"):
+            list(dl)
+    assert time.monotonic() - t0 < 30.0  # bounded, not a hang
+    # teardown did not leak workers to daemon reaping
+    assert _wait_for(lambda: not mp.active_children(), timeout=5.0)
+
+
+def test_wedged_worker_restarts_on_timeout(monkeypatch):
+    monkeypatch.setenv("PADDLE_IO_WORKER_TIMEOUT_S", "0.8")
+    r0 = cmon.stat_get("io/workers/restarts")
+    with chaos.inject("io_fetch", "stall", after=16, times=1,
+                      secs=60):
+        dl = DataLoader(ArangeDS(40), batch_size=2, num_workers=2,
+                        prefetch_to_device=0)
+        vals = [int(np.asarray(y.numpy())[0]) for x, y in dl]
+    assert len(vals) == 20
+    assert cmon.stat_get("io/workers/restarts") > r0
+
+
+def test_bad_sample_skip_single_process():
+    n0 = cmon.stat_get("io/bad_samples")
+    dl = DataLoader(ArangeDS(10, bad=(3,)), batch_size=2,
+                    on_bad_sample="skip", prefetch_to_device=0)
+    ys = []
+    for x, y in dl:
+        ys.extend(int(v) for v in np.asarray(y.numpy()))
+    assert ys == [i for i in range(10) if i != 3]
+    assert cmon.stat_get("io/bad_samples") == n0 + 1
+
+
+def test_bad_sample_raise_is_default():
+    dl = DataLoader(ArangeDS(10, bad=(3,)), batch_size=2,
+                    prefetch_to_device=0)
+    with pytest.raises(ValueError, match="corrupt record"):
+        list(dl)
+
+
+def test_bad_sample_skip_multiprocess_and_whole_batch_drop():
+    n0 = cmon.stat_get("io/bad_samples")
+    # batch [4, 5] fails ENTIRELY -> dropped whole; batch [6, 7]
+    # loses one sample -> partial batch of 1
+    dl = DataLoader(ArangeDS(12, bad=(4, 5, 6)), batch_size=2,
+                    num_workers=2, on_bad_sample="skip",
+                    prefetch_to_device=0)
+    ys = []
+    for x, y in dl:
+        ys.extend(int(v) for v in np.asarray(y.numpy()))
+    assert ys == [0, 1, 2, 3, 7, 8, 9, 10, 11]
+    assert cmon.stat_get("io/bad_samples") == n0 + 3
+
+
+def test_injected_bad_sample_feeds_the_policy():
+    with chaos.inject("io_fetch", "bad_sample", after=4, times=1):
+        dl = DataLoader(ArangeDS(10), batch_size=2,
+                        on_bad_sample="skip", prefetch_to_device=0)
+        ys = []
+        for x, y in dl:
+            ys.extend(int(v) for v in np.asarray(y.numpy()))
+    assert len(ys) == 9  # exactly the injected sample dropped
+
+
+def test_on_bad_sample_validated():
+    with pytest.raises(ValueError):
+        DataLoader(ArangeDS(4), on_bad_sample="explode")
+
+
+def test_on_bad_sample_env_typo_warns(monkeypatch):
+    monkeypatch.setenv("PADDLE_IO_ON_BAD_SAMPLE", "drop")
+    dl = DataLoader(ArangeDS(4), batch_size=2, prefetch_to_device=0)
+    with pytest.warns(RuntimeWarning, match="PADDLE_IO_ON_BAD_SAMPLE"):
+        assert dl._bad_sample_policy() == "raise"
+
+
+def test_on_bad_sample_skip_warns_for_iterable():
+    from paddle_tpu.io import IterableDataset
+
+    class It(IterableDataset):
+        def __iter__(self):
+            return iter([np.zeros((2,), np.float32)])
+
+    with pytest.warns(RuntimeWarning, match="no effect on an "
+                                            "IterableDataset"):
+        DataLoader(It(), batch_size=1, on_bad_sample="skip")
+
+
+def test_batch_size_none_custom_collate_keeps_legacy_contract():
+    """batch_size=None with a custom collate_fn keeps the legacy
+    single-sample contract (_np_collate + device placement) — the
+    policy routing only covers the default-collate path."""
+    dl = DataLoader(ArangeDS(3), batch_size=None,
+                    collate_fn=lambda b: b, prefetch_to_device=0)
+    xs = list(dl)
+    assert len(xs) == 3
+    # device tensors, as before this PR
+    assert hasattr(xs[0][0], "numpy")
+
+
+def test_crash_fault_downgrades_to_raise_outside_mp_worker():
+    """An in-process io_fetch (num_workers=0) must NOT os._exit the
+    trainer — that would bypass the flight excepthook and every
+    emergency-checkpoint path the fault exists to exercise. It raises
+    instead (and so feeds the bad-sample policy like any error)."""
+    with chaos.inject("io_fetch", "crash", times=1):
+        dl = DataLoader(ArangeDS(6), batch_size=2,
+                        prefetch_to_device=0)
+        with pytest.raises(chaos.ChaosInjected, match="outside an mp"):
+            list(dl)
+    # ... and the skip policy must NOT swallow the downgraded crash
+    # (it is fault injection, not a bad record — the chaos counters
+    # would otherwise claim a crash with no observable effect)
+    with chaos.inject("io_fetch", "crash", times=1):
+        dl = DataLoader(ArangeDS(6), batch_size=2,
+                        on_bad_sample="skip", prefetch_to_device=0)
+        with pytest.raises(chaos.ChaosInjected):
+            list(dl)
+
+
+# ---------------------------------------------------------------------------
+# dispatch fault -> OOM forensics path
+# ---------------------------------------------------------------------------
+
+def test_dispatch_resource_exhausted_classifies_as_oom():
+    from paddle_tpu.monitor import memory as mem
+
+    net, opt, step = _mk_step()
+    step(_X, _Y)  # compile + first dispatch clean
+    with chaos.inject("dispatch", "resource_exhausted"):
+        with pytest.raises(Exception) as ei:
+            step(_X, _Y)
+    assert type(ei.value).__name__ == "XlaRuntimeError"
+    assert mem.is_oom_error(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# non-finite step guards
+# ---------------------------------------------------------------------------
+
+def test_guard_nonfinite_trip_is_bit_identical_to_no_step():
+    net, opt, step = _mk_step(guard_nonfinite=True)
+    step(_X, _Y)
+    p0 = {k: np.asarray(p._value) for k, p in net.named_parameters()}
+    s0 = {k: {s: np.asarray(v) for s, v in sl.items()}
+          for k, sl in step._opt_state.items()}
+    n0 = cmon.stat_get("train/nonfinite_skips")
+    xb = _X.copy()
+    xb[0, 0] = np.inf
+    loss = step(xb, _Y)
+    assert not np.isfinite(float(loss.item()))  # loss still reported
+    assert step.last_skips == 1
+    assert cmon.stat_get("train/nonfinite_skips") == n0 + 1
+    for k, p in net.named_parameters():
+        assert np.array_equal(p0[k], np.asarray(p._value)), k
+    for k, sl in step._opt_state.items():
+        for s, v in sl.items():
+            assert np.array_equal(s0[k][s], np.asarray(v)), (k, s)
+    evs = [e for e in flight.tail() if e["kind"] == "nonfinite_skip"]
+    assert evs and evs[-1]["steps"] == 1
+
+
+def test_guard_clean_steps_do_not_skip():
+    net, opt, step = _mk_step(guard_nonfinite=True)
+    p0 = {k: np.asarray(p._value) for k, p in net.named_parameters()}
+    step(_X, _Y)
+    assert step.last_skips == 0
+    changed = any(not np.array_equal(p0[k], np.asarray(p._value))
+                  for k, p in net.named_parameters())
+    assert changed
+
+
+def test_guard_fused_k2_trip_matches_good_batch_only():
+    """steps_per_dispatch=2 with [good, bad] microbatches must leave
+    exactly the state of running ONLY the good batch."""
+    xb = _X.copy()
+    xb[0, 0] = np.inf
+    net2, opt2, s2 = _mk_step(guard_nonfinite=True,
+                              steps_per_dispatch=2)
+    losses = s2(np.stack([_X, xb]), np.stack([_Y, _Y]))
+    lv = np.asarray(losses._value)
+    assert np.isfinite(lv[0]) and not np.isfinite(lv[1])
+    assert s2.last_skips == 1
+    net3, opt3, s3 = _mk_step(guard_nonfinite=True)
+    s3(_X, _Y)
+    for (k, p2), (_, p3) in zip(net2.named_parameters(),
+                                net3.named_parameters()):
+        assert np.array_equal(np.asarray(p2._value),
+                              np.asarray(p3._value)), k
+
+
+def test_guard_merge_boundary_trip_does_not_double_weight():
+    """accumulate_steps=2 with the BOUNDARY microstep tripping: the
+    tripped batch contributes zero gradient but the window still
+    applies on schedule — for SGD the result equals a single step at
+    lr/2 on the good batch alone (a whole-window skip would instead
+    roll the good grads into the NEXT window and double-weight it)."""
+    def mk(lr, **kw):
+        paddle.seed(7)
+        net = nn.Linear(4, 3)
+        opt = optim.SGD(learning_rate=lr,
+                        parameters=net.parameters())
+        step = TrainStepCompiler(
+            net, opt, lambda out, y: ((out - y) ** 2).mean(), **kw)
+        return net, step
+
+    xb = _X.copy()
+    xb[0, 0] = np.inf
+    net_a, step_a = mk(0.2, guard_nonfinite=True, accumulate_steps=2)
+    step_a(_X, _Y)   # accumulates good grads
+    step_a(xb, _Y)   # boundary microstep trips -> zero contribution
+    assert step_a.last_skips == 1
+    net_b, step_b = mk(0.1, guard_nonfinite=True)
+    step_b(_X, _Y)   # one plain step at half the lr
+    for (k, pa), (_, pb) in zip(net_a.named_parameters(),
+                                net_b.named_parameters()):
+        np.testing.assert_allclose(np.asarray(pa._value),
+                                   np.asarray(pb._value),
+                                   rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_guard_survives_demotion_to_eager_path():
+    """fit(guard_nonfinite=True) whose compiled step dies once must
+    keep guarding on the eager fallback — a NaN batch skips the
+    optimizer step there too, counted under train/nonfinite_skips."""
+    from paddle_tpu.hapi.model import Model
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(optim.SGD(learning_rate=0.1,
+                        parameters=net.parameters()),
+              loss=lambda o, y: ((o - y) ** 2).mean())
+    m._guard_nonfinite = True
+    m._compiled_step = False  # simulate a demoted compiled step
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.ones((2, 2), np.float32))
+    m.train_batch([x], [y])
+    p0 = {k: np.asarray(p._value) for k, p in net.named_parameters()}
+    n0 = cmon.stat_get("train/nonfinite_skips")
+    xb = paddle.to_tensor(np.full((2, 4), np.inf, np.float32))
+    loss = m.train_batch([xb], [y])
+    assert not np.isfinite(loss[0])
+    assert cmon.stat_get("train/nonfinite_skips") == n0 + 1
+    for k, p in net.named_parameters():
+        assert np.array_equal(p0[k], np.asarray(p._value)), k
+
+
+def test_grad_scaler_compiled_backoff_and_growth_counters():
+    b0 = cmon.stat_get("amp/scale/backoffs")
+    g0 = cmon.stat_get("amp/scale/growths")
+    from paddle_tpu.amp import GradScaler
+
+    sc = GradScaler(init_loss_scaling=8.0, incr_every_n_steps=2)
+    net, opt, step = _mk_step(grad_scaler=sc)
+    xb = _X.copy()
+    xb[0, 0] = np.inf
+    step(xb, _Y)  # trip -> backoff
+    assert sc._scale == 4.0
+    assert cmon.stat_get("amp/scale/backoffs") == b0 + 1
+    step(_X, _Y)
+    step(_X, _Y)  # 2 good steps -> growth
+    assert sc._scale == 8.0
+    assert cmon.stat_get("amp/scale/growths") == g0 + 1
+
+
+def test_disabled_grad_scaler_is_a_noop_in_compiled_step():
+    """GradScaler(enable=False) must not scale the compiled loss by
+    its (still-initialized) 2**16 scale nor force the guard on — the
+    eager path's enable=False no-op contract holds here too."""
+    from paddle_tpu.amp import GradScaler
+
+    sc = GradScaler(enable=False, init_loss_scaling=2.0 ** 16)
+    net, opt, step = _mk_step(grad_scaler=sc)
+    assert step._grad_scaler is None
+    assert not step._guard_nonfinite
+    p0 = {k: np.asarray(p._value) for k, p in net.named_parameters()}
+    loss = step(_X, _Y)
+    assert np.isfinite(float(loss.item()))
+    assert any(not np.array_equal(p0[k], np.asarray(p._value))
+               for k, p in net.named_parameters())
+
+
+def test_bad_sample_skip_batch_size_none_path():
+    """batch_size=None (one sample per index) honors the per-sample
+    policy and the io_fetch site like every other pipeline path."""
+    n0 = cmon.stat_get("io/bad_samples")
+    dl = DataLoader(ArangeDS(6, bad=(2,)), batch_size=None,
+                    on_bad_sample="skip", prefetch_to_device=0)
+    ys = [int(np.asarray(y.numpy())[0]) for x, y in dl]
+    assert ys == [0, 1, 3, 4, 5]
+    assert cmon.stat_get("io/bad_samples") == n0 + 1
+
+
+def test_fused_oom_demotion_still_writes_bundle(tmp_path):
+    """steps_per_dispatch>1: a RESOURCE_EXHAUSTED in the fused
+    dispatch demotes to K=1 (recovery) but must still leave the OOM
+    bundle the swallowed raise would have produced."""
+    from paddle_tpu.hapi.model import Model
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.ones((4,), np.float32),
+                    np.ones((2,), np.float32))
+
+    paddle.seed(0)
+    m = Model(nn.Linear(4, 2))
+    m.prepare(optim.SGD(learning_rate=0.1,
+                        parameters=m.network.parameters()),
+              loss=lambda o, y: ((o - y) ** 2).mean())
+    with chaos.inject("dispatch", "resource_exhausted", times=1):
+        m.fit(DS(), batch_size=2, epochs=1, verbose=0, shuffle=False,
+              steps_per_dispatch=2)
+    dumps = glob.glob(str(tmp_path / "dumps" / "oom_*.json"))
+    assert dumps, "demoted fused OOM left no bundle"
+    with open(dumps[0]) as f:
+        bundle = json.load(f)
+    assert bundle["recovered"] == "fused_demoted_to_k1"
+    assert "RESOURCE_EXHAUSTED" in bundle["exception"]["message"]
+
+
+def test_terminate_on_nan_suppresses_aborted_epoch_saves(tmp_path):
+    """The aborted (incomplete, diverged) epoch must not be evaluated
+    or saved as a regular epoch checkpoint — same discipline as a
+    preemption stop."""
+    from paddle_tpu.hapi.model import Model
+
+    class NanDS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            x = np.ones((4,), np.float32)
+            if i >= 2:
+                x = x * np.inf
+            return x, np.ones((2,), np.float32)
+
+    paddle.seed(0)
+    m = Model(nn.Linear(4, 2))
+    m.prepare(optim.SGD(learning_rate=0.1,
+                        parameters=m.network.parameters()),
+              loss=lambda o, y: ((o - y) ** 2).mean())
+    sd = str(tmp_path / "epochs")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m.fit(NanDS(), batch_size=2, epochs=2, verbose=0,
+              shuffle=False, save_dir=sd, terminate_on_nan=2)
+    assert m._nonfinite_stopped
+    # no NaN epoch_0 snapshot from the fit loop's save_dir path
+    assert not os.path.exists(os.path.join(sd, "epoch_0.pdparams"))
+
+
+def test_grad_scaler_state_dict_roundtrip_mid_streak():
+    """Satellite: the incr/decr streak counters survive a state_dict
+    round trip MID-STREAK — a restored scaler grows/backs off on the
+    same step it would have without the restart."""
+    from paddle_tpu.amp import GradScaler
+
+    a = GradScaler(init_loss_scaling=16.0, incr_every_n_steps=3,
+                   decr_every_n_nan_or_inf=2)
+    a._record_step(False)
+    a._record_step(False)   # good streak at 2 of 3
+    b = GradScaler(init_loss_scaling=1.0, incr_every_n_steps=3,
+                   decr_every_n_nan_or_inf=2)
+    b.load_state_dict(a.state_dict())
+    assert b._scale == 16.0 and b._good_steps == 2
+    b._record_step(False)   # third good step -> growth fires now
+    assert b._scale == 32.0
+    a._record_step(True)    # bad streak at 1 of 2 (good streak reset)
+    c = GradScaler(init_loss_scaling=1.0, incr_every_n_steps=3,
+                   decr_every_n_nan_or_inf=2)
+    c.load_state_dict(a.state_dict())
+    assert c._bad_steps == 1 and c._good_steps == 0
+    c._record_step(True)    # second bad -> backoff fires now
+    assert c._scale == 8.0
+
+
+def test_fit_terminate_on_nan_checkpoint_then_stop(tmp_path,
+                                                   monkeypatch):
+    from paddle_tpu.hapi.model import Model
+
+    monkeypatch.setenv("PADDLE_CKPT_DIR", str(tmp_path / "ck"))
+    monkeypatch.setenv("PADDLE_JOB_ID", "chaos_nan")
+
+    class NanDS(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            x = np.ones((4,), np.float32)
+            if i >= 6:
+                x = x * np.inf
+            return x, np.ones((2,), np.float32)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(optim.SGD(learning_rate=0.1,
+                        parameters=net.parameters()),
+              loss=lambda o, y: ((o - y) ** 2).mean())
+    s0 = cmon.stat_get("train/nonfinite_stops")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m.fit(NanDS(), batch_size=2, epochs=3, verbose=0,
+              shuffle=False, resume="auto", terminate_on_nan=2,
+              guard_nonfinite=True)
+    assert m.stop_training
+    assert cmon.stat_get("train/nonfinite_stops") == s0 + 1
+    assert any("terminate_on_nan" in str(x.message) for x in w)
+    # guard skipped the diverged updates: params stay finite
+    assert all(np.isfinite(np.asarray(p._value)).all()
+               for _, p in net.named_parameters())
+    # checkpoint-then-stop left a resumable snapshot
+    mgr = CheckpointManager()
+    assert mgr.restore() is not None
+    assert mgr.cursor["global_step"] > 0
+
+
+def test_step_timer_tolerates_nonfinite_loss():
+    """Regression for the bug this harness flushed out: a NaN loss
+    used to crash the Telemetry callback (int(nan)) before
+    terminate_on_nan could act."""
+    from paddle_tpu.monitor import StepTimer
+
+    st = StepTimer()
+    st.begin_step()
+    assert st.end_step(batch_size=4, loss=float("nan"),
+                       lr=float("inf")) is not None
+
+
+# ---------------------------------------------------------------------------
+# self-healing comm bootstrap (store backoff + rich timeouts)
+# ---------------------------------------------------------------------------
+
+class _EmptyStore:
+    def get(self, key):
+        return None
+
+    def put(self, *a, **k):
+        pass
+
+    def delete(self, key):
+        pass
+
+
+def test_store_wait_get_backoff_and_timeout_message():
+    from paddle_tpu.distributed.store_collective import StoreGroupComm
+
+    comm = StoreGroupComm.__new__(StoreGroupComm)
+    comm.ranks = [0, 1]
+    comm.rank = 0
+    comm.tag = "t"
+    comm._store = _EmptyStore()
+    r0 = cmon.stat_get("comm/retries")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError) as ei:
+        comm._wait_get("coll/t/c0/1", 0.4)
+    elapsed = time.monotonic() - t0
+    msg = str(ei.value)
+    # group, elapsed and retry count all present
+    assert "[0, 1]" in msg and "polls" in msg and "after" in msg
+    retries = cmon.stat_get("comm/retries") - r0
+    assert retries > 0
+    # capped EXPONENTIAL backoff: far fewer polls than the old fixed
+    # 5ms cadence would have made (0.4s / 5ms = 80)
+    assert retries < 40, retries
+    assert elapsed < 2.0
+
+
+def test_store_recv_timeout_names_group_seq_and_elapsed():
+    from paddle_tpu.distributed.store_collective import StoreGroupComm
+
+    class _DeafPlane:
+        def recv(self, src, tag, seq, timeout=None):
+            if timeout:
+                time.sleep(min(timeout, 0.05))
+            raise TimeoutError
+
+    comm = StoreGroupComm.__new__(StoreGroupComm)
+    comm.ranks = [0, 2]
+    comm.rank = 0
+    comm.tag = "t"
+    comm._store = _EmptyStore()
+    comm._dp = _DeafPlane()
+    with pytest.raises(TimeoutError) as ei:
+        comm.recv(2, timeout=0.3)
+    msg = str(ei.value)
+    assert "seq 0" in msg and "[0, 2]" in msg and "retries" in msg
+    assert "after" in msg
+
+
+# ---------------------------------------------------------------------------
+# doc-drift: chaos env knobs + bench provenance
+# ---------------------------------------------------------------------------
+
+def test_bench_embeds_resilience_counters():
+    """bench.py must embed the chaos/resilience counters in extra so
+    perf records are provably chaos-free (satellite: CI/tooling)."""
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    for key in ("chaos/", "comm/retries", "train/nonfinite_skips",
+                "io/workers/"):
+        assert key in src, f"bench.py does not embed {key}"
+
+
+def test_chaos_env_documented_in_readme():
+    with open(os.path.join(REPO, "README.md")) as f:
+        doc = f.read()
+    for var in ("PADDLE_CHAOS", "PADDLE_IO_WORKER_RESTARTS",
+                "PADDLE_IO_WORKER_TIMEOUT_S",
+                "PADDLE_IO_ON_BAD_SAMPLE",
+                "PADDLE_JIT_GUARD_NONFINITE"):
+        assert var in doc, f"{var} missing from README"
+    assert "Chaos testing" in doc
