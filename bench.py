@@ -325,11 +325,17 @@ def bench_resnet50_pipeline(on_tpu):
         xs, ys = zip(*b)
         return np.stack(xs), np.stack(ys)
 
+    # worker pool auto-sized from the host (ISSUE 8: saturate a
+    # multi-core host without per-machine tuning)
+    from paddle_tpu.io import _auto_num_workers
+
+    n_workers = _auto_num_workers()
+
     # (1a) machinery rate: zero-copy slot views straight off the rings
     from paddle_tpu.io.worker import MultiprocessLoader
 
-    mpl = MultiprocessLoader(ds, _np_collate_pair, 4, 2, 128, None, 0,
-                             False, batch_size=batch,
+    mpl = MultiprocessLoader(ds, _np_collate_pair, n_workers, 2, 128,
+                             None, 0, False, batch_size=batch,
                              default_collate=True)
     idx = [list(range(i * batch, (i + 1) * batch))
            for i in range(n_loader + warm_l)]
@@ -346,7 +352,7 @@ def bench_resnet50_pipeline(on_tpu):
     view_rate = round(batch / view_dt, 1)
 
     # (1b) user-owned host delivery rate (one detach memcpy per batch)
-    loader_host = DataLoader(ds, batch_size=batch, num_workers=4,
+    loader_host = DataLoader(ds, batch_size=batch, num_workers=-1,
                              use_shared_memory=True, drop_last=True,
                              collate_fn=_np_collate_pair)
     it = iter(loader_host)
@@ -359,7 +365,7 @@ def bench_resnet50_pipeline(on_tpu):
     loader_dt = (time.perf_counter() - t0) / max(got, 1)
     loader_rate = round(batch / loader_dt, 1)
 
-    loader = DataLoader(ds, batch_size=batch, num_workers=4,
+    loader = DataLoader(ds, batch_size=batch, num_workers=-1,
                         use_shared_memory=True, drop_last=True,
                         persistent_workers=True,
                         prefetch_to_device=2)
@@ -393,10 +399,14 @@ def bench_resnet50_pipeline(on_tpu):
         dts.append((time.perf_counter() - t0) / steps)
     _check_decreasing("resnet50_pipeline", first, last)
     dt = float(np.median(dts))
-    r = _pack(round(batch / dt, 1), "imgs/s", dts)
+    # MFU for the pipeline-fed config too (ISSUE 8: MFU per config) —
+    # same per-image FLOPs as the synthetic resnet50 config
+    r = _pack(round(batch / dt, 1), "imgs/s", dts,
+              _mfu(3 * 4.09e9 * batch, dt))
     r["loader_view_imgs_s"] = view_rate
     r["loader_imgs_s"] = loader_rate
     r["host_cpus"] = os.cpu_count()
+    r["loader_workers"] = n_workers
     r["prefetch_to_device"] = 2
     # the sustains-the-device-rate claim is checked, not asserted:
     # record truthfully whether the owned-batch rate meets the
@@ -636,6 +646,34 @@ def main():
             or k in ("comm/retries", "io/bad_samples",
                      "train/nonfinite_skips",
                      "train/nonfinite_stops")}
+        # MFU campaign provenance (ISSUE 8): the persistent
+        # compile-cache counters plus this run's TOTAL compile time —
+        # a second run with a warm PADDLE_COMPILE_CACHE_DIR shows
+        # persistent_cache hits > 0 and a measurably lower
+        # total_compile_us (the warm-vs-cold delta the acceptance
+        # tracks); pallas_fusion records whether the fused kernel
+        # library was armed for these numbers, so fused and unfused
+        # records can't be confused in the trajectory
+        import os as _os
+
+        stats = results["telemetry"]["stats"]
+        try:
+            from paddle_tpu.incubate.nn import pallas as _pallas
+
+            fusion = _pallas.fusion_enabled()
+        except Exception:
+            fusion = False
+        results["compile"] = {
+            "total_compile_us": sum(
+                v for k, v in stats.items()
+                if k.endswith("/compile_us")),
+            "persistent_cache": {
+                k: v for k, v in stats.items()
+                if k.startswith("jit/persistent_cache/")},
+            "cache_dir_set": bool(
+                _os.environ.get("PADDLE_COMPILE_CACHE_DIR")),
+            "pallas_fusion": fusion,
+        }
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
 

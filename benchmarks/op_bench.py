@@ -194,6 +194,72 @@ def build_ops():
             q, fa, {"flops": 2 * 2 * 4 * 16 * 1024 * 1024 * 64 // 2})
     except Exception:
         pass
+
+    # -- fused layernorm->gelu vs the unfused XLA composition --------
+    # (ISSUE 8 acceptance: the fused kernel must beat this twin on
+    # TPU; on CPU both pallas entries record errors — the kernels are
+    # TPU/interpret-only — and the gate skips unresolved entries)
+    ln_w2 = _f32(rng, 1024)
+    ln_b2 = _f32(rng, 1024)
+    act2 = _bf16(rng, 4096, 1024)
+
+    def _unfused_ln_gelu(x):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + 1e-5) * ln_w2 + ln_b2
+        return (jax.nn.gelu(y).astype(jnp.bfloat16)
+                + x * jnp.bfloat16(1e-3))
+
+    ops["layernorm_gelu_unfused_4096x1024_bf16"] = (
+        act2, _unfused_ln_gelu, {"bytes": 2 * act2.nbytes})
+    try:
+        from paddle_tpu.incubate.nn.pallas.layernorm import (
+            fused_layer_norm)
+
+        def _fused_ln_gelu(x):
+            y = fused_layer_norm(x, ln_w2, ln_b2, 1e-5, "gelu", True,
+                                 False)
+            return y + x * jnp.bfloat16(1e-3)
+
+        ops["fused_layernorm_gelu_4096x1024_bf16"] = (
+            act2, _fused_ln_gelu, {"bytes": 2 * act2.nbytes})
+    except Exception:
+        pass
+
+    # -- fused multi-tensor adam update vs the plain composition -----
+    G = 128  # 128 chunks x 32768 = 4.2M parameters
+    p0 = _f32(rng, G, 256, 128)
+    m0 = jnp.zeros((G, 256, 128), jnp.float32)
+    v0 = jnp.zeros((G, 256, 128), jnp.float32)
+    gk = _f32(rng, G, 256, 128) * jnp.float32(1e-2)
+    d1c = jnp.full((G, 1), 0.1, jnp.float32)
+    d2c = jnp.full((G, 1), 0.001, jnp.float32)
+
+    def _unfused_adam(carry):
+        p, m, v = carry
+        m2 = 0.9 * m + 0.1 * gk
+        v2 = 0.999 * v + 0.001 * gk * gk
+        p2 = p - 1e-3 * (m2 / 0.1) / (jnp.sqrt(v2 / 0.001) + 1e-8)
+        return (p2, m2, v2)
+
+    ops["adam_update_unfused_4M"] = ((p0, m0, v0), _unfused_adam,
+                                     {"bytes": 7 * p0.nbytes})
+    try:
+        from paddle_tpu.incubate.nn.pallas.optim import (
+            fused_adam_chunks)
+        wd0 = jnp.zeros((G, 1), jnp.float32)
+        lr0 = jnp.float32(1e-3)
+
+        def _fused_adam(carry):
+            p, m, v = carry
+            return fused_adam_chunks(p, gk, m, v, lr0, d1c, d2c, wd0,
+                                     beta1=0.9, beta2=0.999, eps=1e-8)
+
+        ops["fused_adam_update_4M"] = ((p0, m0, v0), _fused_adam,
+                                       {"bytes": 7 * p0.nbytes})
+    except Exception:
+        pass
     return ops
 
 

@@ -393,6 +393,10 @@ class Model:
         PADDLE_JIT_STEPS_PER_DISPATCH (else 1). num_iters may overshoot
         by up to K-1 steps (a dispatched group is indivisible).
 
+        num_workers=-1 (or "auto") sizes the loader's mp worker pool
+        from the host (PADDLE_IO_WORKERS, else os.cpu_count() capped
+        at 16) — see io.DataLoader.
+
         accumulate_grad_batches=A averages gradients over A batches
         per optimizer step (TrainStepCompiler's gradient merge on the
         compiled path; deferred step + grad averaging on the dygraph

@@ -1,6 +1,8 @@
 """paddle.incubate.nn — fused transformer blocks (reference:
-python/paddle/incubate/nn/layer/fused_transformer.py:25,216,348)."""
+python/paddle/incubate/nn/layer/fused_transformer.py:25,216,348) and
+the Pallas fused-kernel library (paddle_tpu.incubate.nn.pallas)."""
 from . import attention
+from . import pallas
 from .layer.fused_transformer import (
     FusedMultiHeadAttention,
     FusedFeedForward,

@@ -44,22 +44,50 @@ _STAT_LANES = 8
 
 
 def _pick_block(seq, preferred):
-    """Largest power-of-two block <= preferred that divides seq."""
+    """Largest power-of-two block <= preferred that divides seq, or
+    None when the sequence needs padding (no pow2 divisor >= 8)."""
     for cand in (preferred, 512, 256, 128, 64, 32, 16, 8):
         if cand <= preferred and cand <= seq and seq % cand == 0:
             return cand
-    raise ValueError(
-        f"flash_attention: sequence length {seq} has no power-of-two "
-        f"block divisor <= {preferred}; pad the sequence")
+    return None
+
+
+def _block_and_pad(seq, preferred):
+    """(block, padded_seq). Divisor-free lengths (a 129-token prompt,
+    a ragged tail microbatch) pad UP to the next multiple of the
+    largest power-of-two block <= min(preferred, seq): the kernels
+    mask padded KV positions to -inf (exactly zero attention weight)
+    and padded q rows are sliced off, so the unpadded region is
+    bit-identical to an unpadded run — see _mask_scores."""
+    b = _pick_block(seq, preferred)
+    if b is not None:
+        return b, seq
+    b = 8
+    while b * 2 <= min(preferred, seq):
+        b *= 2
+    return b, ((seq + b - 1) // b) * b
 
 _NEG_INF = -1e30
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
+def _mask_scores(s, qi, ki, block_q, block_k, causal, kv_len):
+    """Causal and/or padded-KV masking of one score tile. kv_len is
+    the REAL key length; positions >= kv_len are padding and score
+    -inf (exp underflows to exactly 0 — padded keys contribute
+    nothing, bit-exactly). kv_len=None means no padding."""
+    if not causal and kv_len is None:
+        return s
     bq, bk = s.shape
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        ok = q_pos >= k_pos
+        if kv_len is not None:
+            ok = jnp.logical_and(ok, k_pos < kv_len)
+    else:
+        ok = k_pos < kv_len
+    return jnp.where(ok, s, _NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -68,7 +96,8 @@ def _causal_mask(s, qi, ki, block_q, block_k):
 
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                    acc_ref, m_ref, l_ref, *,
-                   sm_scale, causal, block_q, block_k, num_kv):
+                   sm_scale, causal, block_q, block_k, num_kv,
+                   kv_len=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -78,8 +107,12 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal: blocks strictly above the diagonal contribute nothing
+    # causal: blocks strictly above the diagonal contribute nothing;
+    # fully-padded KV blocks (past the real key length) likewise
     run = (qi + 1) * block_q > ki * block_k if causal else True
+    if kv_len is not None:
+        kv_run = ki * block_k < kv_len
+        run = kv_run if run is True else jnp.logical_and(run, kv_run)
 
     @pl.when(run)
     def _step():
@@ -93,8 +126,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, kv_len)
         m_prev = m_ref[:, :1]                             # [BQ, 1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -117,6 +149,13 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             m + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[1:])
 
 
+def _pad_seq(a, s_pad):
+    s = a.shape[1]
+    if s == s_pad:
+        return a
+    return jnp.pad(a, ((0, 0), (0, s_pad - s), (0, 0)))
+
+
 def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
                     interpret=False):
     # the kernels run matmuls on the operands' own dtype (bf16-native
@@ -127,20 +166,22 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
     q, k, v = q.astype(ct), k.astype(ct), v.astype(ct)
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
-    num_kv = sk // bk
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+    bq, sq_pad = _block_and_pad(sq, block_q)
+    bk, sk_pad = _block_and_pad(sk, block_k)
+    kv_len = sk if sk_pad != sk else None
+    num_kv = sk_pad // bk
+    qr = _pad_seq(q.reshape(b * h, sq, d), sq_pad)
+    kr = _pad_seq(k.reshape(b * h, sk, d), sk_pad)
+    vr = _pad_seq(v.reshape(b * h, sk, d), sk_pad)
     kernel = functools.partial(
         _fa_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=bq, block_k=bk, num_kv=num_kv)
+        block_q=bq, block_k=bk, num_kv=num_kv, kv_len=kv_len)
     out, lse = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b * h, sq, _STAT_LANES), jnp.float32)),
-        grid=(b * h, sq // bq, num_kv),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sq_pad, _STAT_LANES),
+                                        jnp.float32)),
+        grid=(b * h, sq_pad // bq, num_kv),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
@@ -163,7 +204,8 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq)
+    return (out[:, :sq].reshape(b, h, sq, d),
+            lse[:, :sq, 0].reshape(b, h, sq))
 
 
 # ---------------------------------------------------------------------------
@@ -172,7 +214,8 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k,
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dq_acc, *,
-                      sm_scale, causal, block_q, block_k, num_kv):
+                      sm_scale, causal, block_q, block_k, num_kv,
+                      kv_len=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -181,6 +224,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     run = (qi + 1) * block_q > ki * block_k if causal else True
+    if kv_len is not None:
+        kv_run = ki * block_k < kv_len
+        run = kv_run if run is True else jnp.logical_and(run, kv_run)
 
     @pl.when(run)
     def _step():
@@ -194,8 +240,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse)                              # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -211,7 +256,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_acc, dv_acc, *,
-                       sm_scale, causal, block_q, block_k, num_q):
+                       sm_scale, causal, block_q, block_k, num_q,
+                       kv_len=None):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -221,6 +267,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     run = (qi + 1) * block_q > ki * block_k if causal else True
+    if kv_len is not None:
+        kv_run = ki * block_k < kv_len
+        run = kv_run if run is True else jnp.logical_and(run, kv_run)
 
     @pl.when(run)
     def _step():
@@ -234,8 +283,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, kv_len)
         p = jnp.exp(s - lse)                              # [BQ, BK]
         pb = p.astype(do.dtype)
         # dv_j += p^T @ do
@@ -264,21 +312,27 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
                    do.astype(ct))
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
-    num_q = sq // bq
-    num_kv = sk // bk
-    qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
-    dor = do.reshape(b * h, sq, d)
-    # per-row stats ride a 128-lane trailing dim (TPU block tiling)
-    lser = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
-                            (b * h, sq, _STAT_LANES))
+    bq, sq_pad = _block_and_pad(sq, block_q)
+    bk, sk_pad = _block_and_pad(sk, block_k)
+    kv_len = sk if sk_pad != sk else None
+    num_q = sq_pad // bq
+    num_kv = sk_pad // bk
+    qr = _pad_seq(q.reshape(b * h, sq, d), sq_pad)
+    kr = _pad_seq(k.reshape(b * h, sk, d), sk_pad)
+    vr = _pad_seq(v.reshape(b * h, sk, d), sk_pad)
+    dor = _pad_seq(do.reshape(b * h, sq, d), sq_pad)
+    # per-row stats ride a small trailing lane dim (TPU block tiling).
+    # Padded q rows carry lse=0 with do=0, so every gradient
+    # contribution they could make is exactly 0 (see _block_and_pad)
+    lser = jnp.broadcast_to(
+        _pad_seq(lse.reshape(b * h, sq)[:, :, None], sq_pad),
+        (b * h, sq_pad, _STAT_LANES))
     # delta_i = rowsum(do_i * o_i) — cheap fused elementwise + reduce
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(b * h, sq)
-    delta = jnp.broadcast_to(delta[:, :, None], (b * h, sq, _STAT_LANES))
+    delta = jnp.broadcast_to(
+        _pad_seq(delta[:, :, None], sq_pad),
+        (b * h, sq_pad, _STAT_LANES))
 
     q_spec = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
                           memory_space=pltpu.VMEM)
@@ -291,8 +345,8 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          num_kv=num_kv),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                          num_kv=num_kv, kv_len=kv_len),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
         grid=(b * h, num_q, num_kv),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -311,9 +365,9 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          num_q=num_q),
-        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+                          num_q=num_q, kv_len=kv_len),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_pad, d), v.dtype)),
         grid=(b * h, num_kv, num_q),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2,
                   row_spec2],
@@ -323,8 +377,9 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale,
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
 
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    return (dq[:, :sq].reshape(b, h, sq, d),
+            dk[:, :sk].reshape(b, h, sk, d),
+            dv[:, :sk].reshape(b, h, sk, d))
 
 
 # ---------------------------------------------------------------------------

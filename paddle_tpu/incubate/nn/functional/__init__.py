@@ -14,3 +14,87 @@ def fused_multi_head_attention(*args, **kwargs):
 
 def fused_feedforward(*args, **kwargs):
     raise NotImplementedError("use incubate.nn.FusedFeedForward (layer API)")
+
+
+def _ln_fallback(x, weight, bias, epsilon, activation, approximate,
+                 residual):
+    from ....nn import functional as F
+
+    if residual is not None:
+        x = x + residual
+    s = x
+    h = x.shape[-1]
+    y = F.layer_norm(x, [h], weight, bias, epsilon)
+    if activation == "gelu":
+        from ....ops.activation import gelu
+
+        y = gelu(y, approximate=approximate)
+    return y, s
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5, activation=None,
+                     approximate=True, residual=None,
+                     return_residual_sum=False):
+    """Tensor-level fused LayerNorm with optional residual-add
+    prologue and GeLU epilogue (reference:
+    fused_bias_dropout_residual_layer_norm / fused layernorm+act).
+
+    Under PADDLE_PALLAS_FUSION=1 on a supporting backend this is ONE
+    Pallas kernel per direction (incubate.nn.pallas.layernorm); the
+    unfused composition runs otherwise, so calling it is always safe.
+    With `residual`, `return_residual_sum=True` also returns the sum
+    (the next block's residual) computed in the same pass."""
+    if activation not in (None, "gelu"):
+        raise ValueError(
+            f"fused_layer_norm: activation={activation!r} "
+            "(expected None or 'gelu')")
+    from ....core.engine import apply_op
+    from .. import pallas as _pallas
+
+    h = int(x.shape[-1])
+    use_pallas = (_pallas.ln_supported(h)
+                  and weight is not None and bias is not None
+                  and int(weight.shape[0]) == h)
+    if use_pallas:
+        if residual is not None:
+            def k_res(xv, rv, wv, bv, eps, act, approx):
+                y, s = _pallas.fused_residual_layer_norm(
+                    xv, rv, wv, bv, eps, act, approx)
+                return (y, s)
+
+            y, s = apply_op("fused_residual_layer_norm", k_res, x,
+                            residual, weight, bias, eps=float(epsilon),
+                            act=activation, approx=bool(approximate))
+        else:
+            def k_ln(xv, wv, bv, eps, act, approx):
+                return _pallas.fused_layer_norm(xv, wv, bv, eps, act,
+                                                approx)
+
+            y = apply_op("fused_layer_norm", k_ln, x, weight, bias,
+                         eps=float(epsilon), act=activation,
+                         approx=bool(approximate))
+            s = x
+    else:
+        y, s = _ln_fallback(x, weight, bias, epsilon, activation,
+                            approximate, residual)
+    if return_residual_sum:
+        return y, s
+    return y
+
+
+def fused_layer_norm_gelu(x, weight, bias, epsilon=1e-5,
+                          approximate=True):
+    """y = gelu(LayerNorm(x) * weight + bias) — the LayerNorm→GeLU
+    pair as one fused kernel (one activation read per direction)."""
+    return fused_layer_norm(x, weight, bias, epsilon,
+                            activation="gelu", approximate=approximate)
+
+
+def fused_residual_layer_norm(x, residual, weight, bias, epsilon=1e-5,
+                              activation=None, approximate=True):
+    """(y, s): s = x + residual, y = [gelu](LayerNorm(s)) — the
+    residual-add → LayerNorm epilogue in one pass."""
+    return fused_layer_norm(x, weight, bias, epsilon,
+                            activation=activation,
+                            approximate=approximate, residual=residual,
+                            return_residual_sum=True)

@@ -3,9 +3,12 @@ python/paddle/incubate/nn/layer/fused_transformer.py:25,216,348 over
 fused_attention_op.cu / fused_feedforward_op.cu).
 
 TPU-native: "fusion" = one jitted region per block; attention core is
-the Pallas flash kernel; the residual+dropout+layernorm epilogues are
-left to XLA fusion (which matches the reference's fused_dropout_helper
-coverage on TPU)."""
+the Pallas flash kernel. The residual+layernorm epilogues (and the
+pre-LN prologues) route through incubate.nn.functional.fused_layer_norm
+— under PADDLE_PALLAS_FUSION=1 that is the fused Pallas kernel
+(incubate.nn.pallas.layernorm, the reference's
+fused_bias_dropout_residual_layer_norm analog), and the plain XLA
+composition otherwise."""
 from __future__ import annotations
 
 import numpy as np
@@ -63,11 +66,13 @@ class FusedMultiHeadAttention(Layer):
                 cache=None):
         from ....ops.manipulation import reshape, transpose, split
 
+        from ... import nn as _inn
+
         residual = query
         x = query
         if self.normalize_before:
-            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
-                             self.pre_ln_bias, self._epsilon)
+            x = _inn.functional.fused_layer_norm(
+                x, self.pre_ln_scale, self.pre_ln_bias, self._epsilon)
         qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
         b, s = qkv.shape[0], qkv.shape[1]
         qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
@@ -80,10 +85,13 @@ class FusedMultiHeadAttention(Layer):
         out = reshape(out, [b, s, self.embed_dim])
         out = F.linear(out, self.linear_weight, self.linear_bias)
         out = F.dropout(out, self.dropout_rate, training=self.training)
-        out = residual + out
         if not self.normalize_before:
-            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
-                               self.ln_bias, self._epsilon)
+            # residual-add -> LayerNorm in one fused pass
+            out = _inn.functional.fused_layer_norm(
+                out, self.ln_scale, self.ln_bias, self._epsilon,
+                residual=residual)
+        else:
+            out = residual + out
         return out
 
 
@@ -123,22 +131,25 @@ class FusedFeedForward(Layer):
                                               attr=ln2_bias_attr)
 
     def forward(self, src, cache=None):
+        from ... import nn as _inn
         from ....ops import activation as A
 
         residual = src
         if self._normalize_before:
-            src = F.layer_norm(src, [self._d_model], self.ln1_scale,
-                               self.ln1_bias, self._epsilon)
+            src = _inn.functional.fused_layer_norm(
+                src, self.ln1_scale, self.ln1_bias, self._epsilon)
         act = getattr(A, self._act)
         out = F.linear(src, self.linear1_weight, self.linear1_bias)
         out = F.dropout(act(out), self._act_dropout_rate,
                         training=self.training)
         out = F.linear(out, self.linear2_weight, self.linear2_bias)
         out = F.dropout(out, self._dropout_rate, training=self.training)
-        out = residual + out
         if not self._normalize_before:
-            out = F.layer_norm(out, [self._d_model], self.ln2_scale,
-                               self.ln2_bias, self._epsilon)
+            out = _inn.functional.fused_layer_norm(
+                out, self.ln2_scale, self.ln2_bias, self._epsilon,
+                residual=residual)
+        else:
+            out = residual + out
         return out
 
 

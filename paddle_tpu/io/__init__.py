@@ -415,6 +415,34 @@ def _detach_views(obj):
     return copy.deepcopy(obj)
 
 
+def _auto_num_workers():
+    """Worker count for num_workers=-1/"auto": PADDLE_IO_WORKERS when
+    set, else os.cpu_count() capped at 16 (beyond that the trainer-side
+    ring pops and the single H2D stream are the bottleneck, and each
+    worker pins a whole shm ring). The trainer thread doesn't get a
+    reserved core — it mostly blocks in ring pops, so decode workers
+    on every core win even on 2-core hosts (measured: the pop-side
+    memcpy overlaps worker decode)."""
+    env = os.environ.get("PADDLE_IO_WORKERS")
+    if env:
+        try:
+            # clamped to >= 1: auto-sizing always means SOME worker
+            # pool (bench feeds this straight into MultiprocessLoader,
+            # whose round-robin math divides by it); to disable
+            # workers pass num_workers=0 explicitly
+            return max(1, int(env))
+        except ValueError:
+            pass
+    n = os.cpu_count() or 1
+    return max(1, min(n, 16))
+
+
+def _resolve_num_workers(n):
+    if n in (-1, "auto"):
+        return _auto_num_workers()
+    return n
+
+
 _cpu_backend = None
 
 
@@ -561,11 +589,16 @@ class DataLoader:
         batches re-fed in order) before the epoch fails. Default
         PADDLE_IO_WORKER_RESTARTS (2). A worker that is alive but
         silent past PADDLE_IO_WORKER_TIMEOUT_S seconds counts as
-        wedged (0 = never, the default)."""
+        wedged (0 = never, the default).
+
+        num_workers=-1 (or "auto") sizes the mp worker pool from the
+        host: PADDLE_IO_WORKERS when set, else os.cpu_count() capped
+        at 16 — an image pipeline saturates a multi-core host without
+        per-machine tuning."""
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn
-        self.num_workers = num_workers
+        self.num_workers = _resolve_num_workers(num_workers)
         self.prefetch_factor = prefetch_factor
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout

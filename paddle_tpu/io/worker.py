@@ -73,6 +73,16 @@ def _zero_copy_enabled():
     return os.environ.get("FLAGS_dataloader_zero_copy", "1") != "0"
 
 
+def _overlap_decode_enabled():
+    """Worker-side decode/collate overlap (PADDLE_IO_OVERLAP_DECODE,
+    default on): a decode thread runs dataset[i] fetches one index
+    batch AHEAD while the worker main thread collates into / waits on
+    the shm ring — sample decode rides under ring backpressure instead
+    of serializing behind it."""
+    return os.environ.get("PADDLE_IO_OVERLAP_DECODE", "1") not in (
+        "0", "false", "off")
+
+
 def _slot_overflow(nbytes, slot_bytes):
     return ValueError(
         f"batch of {nbytes} bytes exceeds the shared-memory slot "
@@ -436,17 +446,66 @@ def _worker_loop(worker_id, num_workers, dataset, collate_fn, ring_name,
                         (type(e).__name__, traceback.format_exc())))
                 ring.push(_EOF)
             return
-        while True:
+        # map mode. With PADDLE_IO_OVERLAP_DECODE=1 (default) a decode
+        # thread fetches the NEXT index batch's samples while this
+        # thread collates the current one into the ring (or blocks on
+        # ring backpressure) — the queue keeps marker/batch order, so
+        # EOF/QUIT handling and the fed-log restart contract are
+        # unchanged. With overlap off, _next_work inlines the fetch.
+        q_local = None
+        if _overlap_decode_enabled():
+            import queue as _qmod
+
+            q_local = _qmod.Queue(maxsize=1)
+
+            def _decode_loop():
+                while True:
+                    item = index_queue.get()
+                    if item is None or item == "QUIT":
+                        q_local.put((item, None))
+                        if item == "QUIT":
+                            return
+                        continue
+                    try:
+                        q_local.put(("BATCH", _fetch_samples(
+                            dataset, item, worker_id, on_bad_sample)))
+                    except BaseException as e:
+                        import traceback
+
+                        q_local.put(("ERR", (type(e).__name__,
+                                             traceback.format_exc())))
+
+            threading.Thread(target=_decode_loop, daemon=True,
+                             name="paddle-io-decode").start()
+
+        def _next_work():
+            if q_local is not None:
+                return q_local.get()
             item = index_queue.get()
-            if item is None:
+            if item is None or item == "QUIT":
+                return item, None
+            try:
+                return "BATCH", _fetch_samples(dataset, item,
+                                               worker_id, on_bad_sample)
+            except BaseException as e:
+                import traceback
+
+                return "ERR", (type(e).__name__,
+                               traceback.format_exc())
+
+        while True:
+            kind, payload = _next_work()
+            if kind is None:
                 ring.push(_EOF)
                 # persistent workers loop for the next epoch's indices
                 continue
-            if item == "QUIT":
+            if kind == "QUIT":
                 break
+            if kind == "ERR":  # surface the fetch error to the trainer
+                ring.push(_ERR + pickle.dumps(payload))
+                continue
+            samples, skipped, err = payload
             try:
-                samples, skipped, err = _fetch_samples(
-                    dataset, item, worker_id, on_bad_sample)
                 if skipped:
                     # skip-and-count: the trainer must still see ONE
                     # payload for this fed batch (ring order), so the

@@ -31,6 +31,7 @@ from ..core.tensor import Tensor
 from ..monitor import chaos as _chaos
 from ..monitor import flight as _flight
 from ..ops import random as _random
+from . import persistent_cache as _pcache
 from . import state as _jstate
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TracedLayer",
@@ -212,6 +213,40 @@ def _telemetry_name(func):
     return ".".join(parts[-2:])
 
 
+class _PersistedProgram:
+    """A disk-cache executable standing in for a jitted callable
+    (jit.persistent_cache): calls dispatch to the (possibly
+    deserialized) executable; `.lower` stays on the jitted original so
+    the memory-footprint capture path is unchanged. A signature
+    surprise latches a permanent fallback to the jitted fn — which
+    recompiles exactly as if the cache never existed."""
+
+    def __init__(self, compiled, jfn):
+        self._compiled = compiled
+        self._jfn = jfn
+        self._fallback = False
+
+    def __call__(self, *args):
+        if not self._fallback:
+            if any(isinstance(leaf, jax.core.Tracer)
+                   for leaf in tree_util.tree_leaves(args)):
+                # a trace context (the differentiable to_static path:
+                # apply_op's vjp traces through us) — an AOT
+                # executable can't be traced, but the jitted fn can
+                # and inlines into the outer program. Per-call detour,
+                # NOT a latch: concrete calls keep the cached
+                # executable
+                return self._jfn(*args)
+            try:
+                return self._compiled(*args)
+            except TypeError:
+                self._fallback = True
+        return self._jfn(*args)
+
+    def lower(self, *args, **kwargs):
+        return self._jfn.lower(*args, **kwargs)
+
+
 class StaticFunction:
     """Compiled wrapper (reference: StaticFunction,
     program_translator.py:236)."""
@@ -336,6 +371,9 @@ class StaticFunction:
                 compile_ev.end()
                 _flight.end(compile_tok)
                 raise
+            if _pcache.enabled():
+                entry = self._load_persistent(entry, params, flat_args,
+                                              tensor_pos)
             self._compiled[key] = entry
         else:
             _monitor.stat_add(f"jit/{fname}/cache_hit", 1)
@@ -396,6 +434,31 @@ class StaticFunction:
                 if call_ok:
                     self._capture_memory(key, entry[0], params,
                                          flat_args, tensor_pos)
+
+    def _load_persistent(self, entry, params, flat_args, tensor_pos):
+        """Route a fresh build through the persistent on-disk compile
+        cache (PADDLE_COMPILE_CACHE_DIR): the trace+lower still runs
+        here (cheap, process-local, fills the output box), but a warm
+        entry replaces the expensive XLA backend compile with a
+        deserialize. Any trouble keeps the plain jitted entry — the
+        cache can only ever cost a miss."""
+        jfn, box = entry
+        try:
+            p_structs = [jax.ShapeDtypeStruct(tuple(p._value.shape),
+                                              p._value.dtype)
+                         for p in params]
+            a_structs = [jax.ShapeDtypeStruct(
+                tuple(flat_args[i]._value.shape),
+                flat_args[i]._value.dtype) for i in tensor_pos]
+            lowered = jfn.lower(p_structs, a_structs,
+                                jax.ShapeDtypeStruct((), jnp.uint32))
+            compiled, outcome = _pcache.load_or_compile(
+                lowered, f"to_static:{self._telemetry_key}")
+            if outcome == "off":
+                return entry
+            return _PersistedProgram(compiled, jfn), box
+        except Exception:
+            return entry
 
     def _build(self, target, params, args_treedef, tensor_pos,
                static_leaves, arg_sg=None):
@@ -910,6 +973,9 @@ class TrainStepCompiler:
                                        "JitCompile"), \
                     _flight.in_flight("compile", "train_step"):
                 self._build(trainable, frozen, bufs, batch)
+                if _pcache.enabled():
+                    self._load_persistent(trainable, frozen, bufs,
+                                          batch)
                 out = self._run_compiled(trainable, frozen, bufs, batch)
             _monitor.stat_add(
                 "jit/train_step/compile_us",
@@ -919,6 +985,43 @@ class TrainStepCompiler:
         _monitor.stat_add("jit/train_step/cache_hit", 1)
         _flight.record("jit_cache_hit", fn="train_step")
         return self._run_compiled(trainable, frozen, bufs, batch)
+
+    def _load_persistent(self, trainable, frozen, bufs, batch):
+        """Persistent-compile-cache leg of the first dispatch: lower
+        the freshly built step over the live values (shared with the
+        call path) and swap in the cached executable when the on-disk
+        cache has this exact program — fleet rollouts, bench reruns
+        and reshape-resume relaunches skip the backend compile. Best
+        effort: any trouble keeps the plain jitted step."""
+        try:
+            pvals = {k: p._value for k, p in trainable.items()}
+            fvals = {k: p._value for k, p in frozen.items()}
+            bvals = {k: b._value for k, b in bufs.items()}
+            avals = self._place_batch(batch)
+            lr = np.float32(self._opt.get_lr())
+            rngc = np.uint32(self._step)
+            lowered = self._compiled.lower(
+                pvals, self._opt_state, self._accum_state, fvals,
+                bvals, avals, lr, rngc, self._loss_scale())
+            label = f"train_step:{type(self._model).__name__}"
+            k = self._steps_per_dispatch
+            if k != 1:
+                label += f"@k{k}"
+            compiled, outcome = _pcache.load_or_compile(
+                lowered, label, extra=self._pcache_extra())
+            if outcome != "off":
+                self._compiled = _PersistedProgram(compiled,
+                                                   self._compiled)
+        except Exception:
+            pass
+
+    def _pcache_extra(self):
+        """Extra persistent-cache digest legs beyond the lowered
+        module text. The distributed subclass adds the mesh's device
+        assignment — two processes can lower identical StableHLO over
+        DIFFERENT device orders, and a serialized executable is bound
+        to its assignment."""
+        return ()
 
     def _capture_memory(self, batch):
         """Record the freshly compiled step's memory_analysis()

@@ -173,6 +173,18 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                 self._accum_state[k] = jax.device_put(
                     self._hostify(self._accum_state[k]), sh)
 
+    def _pcache_extra(self):
+        """Persistent-compile-cache digest legs: GSPMD shardings ride
+        the lowered module text already, but the executable is ALSO
+        bound to the mesh's physical device assignment — key on it so
+        a relaunch with a reordered/reshaped device list can never
+        load a stale executable (the elastic reshape-resume path hits
+        this: dp=8 and dp=4 x sharding=2 meshes must not collide)."""
+        m = self._mesh
+        return (tuple(m.axis_names),
+                tuple(int(m.shape[a]) for a in m.axis_names),
+                tuple(str(d) for d in np.ravel(m.devices)))
+
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
         mesh = self._mesh
         repl = NamedSharding(mesh, P())

@@ -19,6 +19,7 @@ class SGD(Optimizer):
     """reference: optimizer.py SGD / phi sgd kernel."""
 
     _slot_names = ()
+    _pallas_fused_kind = "sgd"
 
     def _update(self, param, grad, slots, lr):
         new_p = param.astype(jnp.float32) - lr * grad
@@ -29,6 +30,7 @@ class Momentum(Optimizer):
     """reference: Momentum (use_nesterov option, momentum_op)."""
 
     _slot_names = ("velocity",)
+    _pallas_fused_kind = "momentum"
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -52,6 +54,7 @@ class Adam(Optimizer):
     """reference: Adam (adam_op; beta pows as accumulators)."""
 
     _slot_names = ("moment1", "moment2")
+    _pallas_fused_kind = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
@@ -94,6 +97,7 @@ class AdamW(Adam):
     """reference: AdamW — decoupled weight decay."""
 
     _decoupled_wd = True
+    _pallas_fused_kind = "adamw"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,

@@ -22,6 +22,11 @@ __all__ = ["Optimizer"]
 
 class Optimizer:
     _slot_names = ()  # e.g. ("moment1", "moment2")
+    # multi-tensor Pallas fusion (incubate.nn.pallas.optim): subclasses
+    # whose _update rule has a fused-kernel twin set this to its kind;
+    # apply_gradients then replaces the per-parameter loop with ONE
+    # kernel launch under PADDLE_PALLAS_FUSION=1
+    _pallas_fused_kind = None
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
@@ -164,9 +169,23 @@ class Optimizer:
     def apply_gradients(self, params: dict, grads: dict, state: dict, lr):
         """Pure: used inside jit. Applies clip + wd + rule. When a
         'master_weight' slot exists (multi_precision), the fp32 master
-        is updated and the half-precision param re-derived from it."""
+        is updated and the half-precision param re-derived from it.
+
+        Under PADDLE_PALLAS_FUSION=1 (and a backend that can run the
+        kernels) optimizers with a fused twin (_pallas_fused_kind)
+        route through incubate.nn.pallas.optim.apply_fused — the whole
+        parameter set updates in ONE kernel launch; anything the fused
+        path can't express exactly falls back to the loop below."""
         if self._grad_clip is not None:
             grads = self._grad_clip.functional_clip(grads)
+        if self._pallas_fused_kind is not None:
+            from ..incubate.nn import pallas as _pallas
+
+            if _pallas.kernels_available():
+                out = _pallas.optim.apply_fused(self, params, grads,
+                                                state, lr)
+                if out is not None:
+                    return out
         wd = self._wd_coeff()
         decoupled = getattr(self, "_decoupled_wd", False)
         new_params, new_state = {}, {}

@@ -52,7 +52,14 @@ class BertEmbeddings(Layer):
         emb = (self.word_embeddings(input_ids)
                + self.position_embeddings(pos)
                + self.token_type_embeddings(token_type_ids))
-        return self.dropout(self.layer_norm(emb))
+        # fused Pallas LayerNorm under PADDLE_PALLAS_FUSION=1 (falls
+        # back to the plain composition otherwise)
+        from ...incubate.nn import functional as IF
+
+        normed = IF.fused_layer_norm(emb, self.layer_norm.weight,
+                                     self.layer_norm.bias,
+                                     self.layer_norm._epsilon)
+        return self.dropout(normed)
 
 
 class BertModel(Layer):

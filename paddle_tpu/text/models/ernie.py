@@ -67,10 +67,12 @@ class ErnieBlock(Layer):
         self.dropout = c.dropout
 
     def forward(self, x):
+        from ...incubate.nn import functional as IF
         from ...ops.manipulation import reshape, transpose, split
 
         residual = x
-        h = self.ln1(x)
+        h = IF.fused_layer_norm(x, self.ln1.weight, self.ln1.bias,
+                                self.ln1._epsilon)
         qkv = self.qkv(h)
         b, s = qkv.shape[0], qkv.shape[1]
         q, k, v = split(qkv, 3, axis=2)
@@ -83,9 +85,12 @@ class ErnieBlock(Layer):
         attn = F.scaled_dot_product_attention(q, k, v, is_causal=False,
                                               training=self.training)
         attn = reshape(transpose(attn, [0, 2, 1, 3]), [b, s, -1])
-        x = residual + self.proj(attn)
+        # residual-add -> LayerNorm fused into one pass when armed;
+        # the sum comes back as the next residual
+        h, x = IF.fused_residual_layer_norm(
+            self.proj(attn), residual, self.ln2.weight, self.ln2.bias,
+            self.ln2._epsilon)
         residual = x
-        h = self.ln2(x)
         x = residual + self.fc2(F.gelu(self.fc1(h)))
         return x
 

@@ -135,11 +135,44 @@ def _attention(q, k, v, n_head, use_flash, use_ring=False):
     return out.transpose(0, 2, 1, 3).reshape(b, s, h)
 
 
+def _pallas_ln_ready(h):
+    """Fused Pallas LayerNorm armed (PADDLE_PALLAS_FUSION=1) and able
+    to take this hidden size on the current backend."""
+    try:
+        from ...incubate.nn import pallas as _pl
+
+        return _pl.ln_supported(int(h))
+    except Exception:
+        return False
+
+
 def _layer_norm(x, w, b, eps):
+    if _pallas_ln_ready(x.shape[-1]):
+        try:
+            from ...incubate.nn.pallas import fused_layer_norm
+
+            return fused_layer_norm(x, w, b, eps)
+        except Exception:
+            pass
     xf = x.astype(jnp.float32)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _residual_layer_norm(add, x, w, b, eps):
+    """(LayerNorm(x + add), x + add) — fused into one Pallas pass when
+    armed (the fused_bias_dropout_residual_layer_norm epilogue), the
+    plain two-op composition otherwise."""
+    if _pallas_ln_ready(x.shape[-1]):
+        try:
+            from ...incubate.nn.pallas import fused_residual_layer_norm
+
+            return fused_residual_layer_norm(add, x, w, b, eps)
+        except Exception:
+            pass
+    s = x + add
+    return _layer_norm(s, w, b, eps), s
 
 
 def _dropout(x, rate, key):
@@ -161,8 +194,8 @@ def _block(x, bp, key, n_head, eps, use_flash, dropout, use_ring=False):
     attn = _attention(q, k, v, n_head, use_flash, use_ring)
     attn = attn @ bp["proj_w"] + bp["proj_b"]
     attn = _dropout(attn, dropout, k1)
-    x = x + _maybe_constrain(attn, ("dp", "sp", None))
-    h = _layer_norm(x, bp["ln2_w"], bp["ln2_b"], eps)
+    h, x = _residual_layer_norm(_maybe_constrain(attn, ("dp", "sp", None)),
+                                x, bp["ln2_w"], bp["ln2_b"], eps)
     ffn = h @ bp["fc1_w"] + bp["fc1_b"]
     ffn = jax.nn.gelu(_maybe_constrain(ffn, ("dp", "sp", "mp")))
     ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]

@@ -92,3 +92,62 @@ def test_flash_long_sequence_8k():
     assert np.isfinite(float(loss))
     for g in grads:
         assert bool(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# divisor-free sequence lengths: padded, masked, bit-exact on the
+# unpadded region (ISSUE 8 satellite — _pick_block used to hard-raise)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [7, 129])
+def test_flash_padded_sequence_matches_dense(causal, s):
+    """Lengths with no power-of-two block divisor pad up inside the
+    wrapper; padded KV positions are masked to exactly zero weight and
+    padded q rows sliced off."""
+    q, k, v = _rand_qkv(s=s, d=16, seed=7)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = flash_attention(q, k, v, causal, scale, 1024, 1024, True)
+    _, ref = _attn_ref(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_padded_sequence_grads():
+    q, k, v = _rand_qkv(s=129, d=16, seed=8)
+    scale = 0.25
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, scale, 1024, 1024,
+                                       True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attn_ref(q, k, v, True, scale)[1] ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_padded_cross_attention():
+    # sq != sk, neither divisible: both sides pad independently
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 2, 129, 16), jnp.float32) * 0.5
+    k = jnp.asarray(rng.randn(1, 2, 72, 16), jnp.float32) * 0.5
+    v = jnp.asarray(rng.randn(1, 2, 72, 16), jnp.float32) * 0.5
+    out = flash_attention(q, k, v, False, 0.25, 1024, 1024, True)
+    _, ref = _attn_ref(q, k, v, False, 0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_block_and_pad_prefers_divisors():
+    from paddle_tpu.incubate.nn.attention_pallas import _block_and_pad
+
+    assert _block_and_pad(1024, 1024) == (1024, 1024)  # exact
+    assert _block_and_pad(384, 1024) == (128, 384)     # divisor path
+    assert _block_and_pad(129, 1024) == (128, 256)     # padded
+    assert _block_and_pad(7, 1024) == (8, 8)           # tiny

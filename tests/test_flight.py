@@ -716,6 +716,13 @@ def test_monitor_env_vars_documented_in_readme():
     files += glob.glob(
         os.path.join(REPO, "paddle_tpu", "incubate", "checkpoint",
                      "*.py"))
+    # fused Pallas kernel library + fused optimizer entry
+    # (PADDLE_PALLAS_* — ISSUE 8)
+    files += glob.glob(
+        os.path.join(REPO, "paddle_tpu", "incubate", "nn", "pallas",
+                     "*.py"))
+    files += glob.glob(
+        os.path.join(REPO, "paddle_tpu", "optimizer", "*.py"))
     assert files, "monitor sources not found"
     pat = re.compile(r"PADDLE_[A-Z0-9_]+")
     used = set()
