@@ -674,8 +674,35 @@ def main():
                 _os.environ.get("PADDLE_COMPILE_CACHE_DIR")),
             "pallas_fusion": fusion,
         }
+        # runtime sanitizer provenance (ISSUE 10): which PADDLE_SANITIZE
+        # families were armed for this run plus every sanitize/* and
+        # PTA04x/05x/06x findings counter
+        from paddle_tpu.monitor import sanitize as _sanitize
+
+        results["sanitize"] = {
+            "armed": _sanitize.families(),
+            "counters": {
+                k: v for k, v in stats.items()
+                if k.startswith(("sanitize/", "analysis/PTA04",
+                                 "analysis/PTA05", "analysis/PTA06"))}}
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
+    # zero-overhead contract, asserted OUTSIDE the telemetry
+    # try/except so a regression actually fails the bench: like the
+    # chaos `_armed` gate, disarmed sanitizers must leave NO counters
+    # behind. Scoped to the counters only ARMED runtime hooks create:
+    # sanitize/spec_errors records a rejected (ignored) spec, and the
+    # analysis/PTA0xx findings counters are also fed by the
+    # report-only static passes under PADDLE_ANALYSIS=1 — neither is
+    # runtime-sanitizer overhead
+    san_extra = results.get("sanitize")
+    if san_extra is not None and not san_extra["armed"]:
+        leaked = {k: v for k, v in san_extra["counters"].items()
+                  if k.startswith("sanitize/")
+                  and k != "sanitize/spec_errors"}
+        assert not leaked, (
+            "disarmed sanitizers left counters behind "
+            f"(zero-overhead contract broken): {leaked}")
 
     flag = results.get("gpt2_345m", {})
     out = {

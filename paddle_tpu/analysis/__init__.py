@@ -39,6 +39,13 @@ from .collectives import (check_collectives, collect_comm_ops,
 from .preflight import preflight, preflight_source
 from .program import (DeadVarAnalysisPass, OpCoverageAnalysisPass,
                       UnfetchedOutputAnalysisPass, analyze_program)
+# sanitizer suite (ISSUE 10): static passes + the runtime-armed core
+from . import concurrency, donation, sanitize, sharding
+from .concurrency import lint_locks_source
+from .donation import (audit_aliases, audit_donation,
+                       lint_donation_source)
+from .sharding import (check_batch_specs, check_replicated_params,
+                       check_spec, lint_sharding_source)
 
 __all__ = [
     "DIAGNOSTICS", "Finding", "Report", "Severity", "check",
@@ -47,6 +54,10 @@ __all__ = [
     "DeadVarAnalysisPass", "UnfetchedOutputAnalysisPass",
     "OpCoverageAnalysisPass", "is_suppressed", "fn_anchor",
     "collect_comm_ops", "comm_digest", "compare_comm_digests",
+    "sanitize", "donation", "sharding", "concurrency",
+    "audit_donation", "audit_aliases", "lint_donation_source",
+    "lint_locks_source", "lint_sharding_source", "check_spec",
+    "check_batch_specs", "check_replicated_params",
 ]
 
 

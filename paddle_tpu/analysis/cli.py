@@ -1,12 +1,15 @@
 """CLI: `python -m paddle_tpu.analysis <file|dir|module> ...`
 
-AST-surface lint (the dy2static preflight) over source files — no
-import of the target, no trace, so it runs on anything, fast. Exit
-status is the error-count truth: nonzero iff any error-severity
-finding survives `# noqa: PTA0xx` suppression. The deeper jaxpr/
-collective analyzers need shapes, so they run through the
-programmatic `analysis.check(fn, input_spec=...)` or the
-`PADDLE_ANALYSIS=1` trace-time hook instead.
+AST-surface lint (the dy2static preflight, plus — with `--sanitize`
+— the PTA04x/05x/06x sanitizer static passes: source-level
+use-after-donate, blocking-work-under-lock, invalid PartitionSpec
+literals) over source files — no import of the target, no trace, so
+it runs on anything, fast. Exit status is the error-count truth:
+nonzero iff any error-severity finding survives `# noqa: PTA0xx`
+suppression. The deeper jaxpr/collective analyzers need shapes, so
+they run through the programmatic `analysis.check(fn,
+input_spec=...)` or the `PADDLE_ANALYSIS=1` trace-time hook; the
+runtime sanitizer halves arm via `PADDLE_SANITIZE`.
 """
 from __future__ import annotations
 
@@ -46,14 +49,35 @@ def iter_target_files(target):
     return [spec.origin]
 
 
-def lint_file(path, report=None, traced_only=True):
-    """Preflight one file, applying `# noqa` line suppression."""
+# --sanitize static-pass registry: family -> source linter. These are
+# the AST halves of the sanitizer suite (runtime halves arm via
+# PADDLE_SANITIZE); import lazily so the bare preflight CLI stays
+# light.
+SANITIZE_FAMILIES = ("donation", "locks", "sharding")
+
+
+def _sanitize_passes(families):
+    from .concurrency import lint_locks_source
+    from .donation import lint_donation_source
+    from .sharding import lint_sharding_source
+
+    table = {"donation": lint_donation_source,
+             "locks": lint_locks_source,
+             "sharding": lint_sharding_source}
+    return [table[f] for f in families]
+
+
+def lint_file(path, report=None, traced_only=True, sanitize=()):
+    """Preflight (+ requested sanitizer static passes) over one file,
+    applying `# noqa` line suppression."""
     report = report if report is not None else Report()
     with open(path, encoding="utf-8", errors="replace") as f:
         source = f.read()
     lines = source.splitlines()
     raw = preflight_source(source, filename=path,
                            traced_only=traced_only)
+    for run in _sanitize_passes(sanitize):
+        run(source, filename=path, report=raw)
     for finding in raw.findings:
         text = (lines[finding.line - 1]
                 if finding.line and finding.line <= len(lines) else "")
@@ -77,7 +101,31 @@ def main(argv=None):
                          "(default: @to_static + forward only)")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress info-severity findings in output")
+    ap.add_argument("--sanitize", nargs="?", const="all",
+                    metavar="FAMILIES",
+                    help="also run the sanitizer static passes "
+                         "(PTA04x donation, PTA05x sharding, PTA06x "
+                         "locks); optional comma list "
+                         "donation,locks,sharding (default: all)")
     args = ap.parse_args(argv)
+
+    sanitize = ()
+    if args.sanitize:
+        if args.sanitize.strip().lower() in ("all", "1"):
+            sanitize = SANITIZE_FAMILIES
+        else:
+            sanitize = tuple(
+                f.strip().lower()
+                for f in args.sanitize.replace(";", ",").split(",")
+                if f.strip())
+            unknown = [f for f in sanitize
+                       if f not in SANITIZE_FAMILIES]
+            if unknown:
+                print(f"error: unknown sanitize family/ies "
+                      f"{unknown} (known: "
+                      f"{', '.join(SANITIZE_FAMILIES)})",
+                      file=sys.stderr)
+                return 2
 
     report = Report()
     nfiles = 0
@@ -90,7 +138,8 @@ def main(argv=None):
         for path in files:
             nfiles += 1
             lint_file(path, report,
-                      traced_only=not args.all_functions)
+                      traced_only=not args.all_functions,
+                      sanitize=sanitize)
 
     shown = [f for f in report.sorted()
              if not (args.quiet and f.severity == Severity.INFO)]

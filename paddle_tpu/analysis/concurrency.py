@@ -1,0 +1,246 @@
+"""PTA06x concurrency sanitizer — static blocking-under-lock pass.
+
+The runtime half (instrumented `SanLock` wrappers, the cross-thread
+lock-order graph with cycle detection, timed holds, the at-exit
+thread census) lives in `paddle_tpu.monitor.sanitize` and is
+re-exported here. This module adds the STATIC pass the CLI
+`--sanitize` runs: an AST walk that finds blocking work inside a
+held-lock region — the watchdog-vs-wedged-writer / daemon-teardown
+class of deadlock, caught at review time instead of in a hung pod.
+
+Flagged under a held lock (PTA062):
+
+  * `x.join()` with no timeout — unbounded thread/queue join
+  * `time.sleep(...)` / bare `sleep(...)`
+  * `x.wait()` with no timeout on an object OTHER than the held lock
+    (``cv.wait()`` inside ``with cv:`` RELEASES the lock — the
+    normal condition pattern is never flagged)
+  * `y.acquire()` with no timeout and no `blocking=False` — a nested
+    unbounded acquire; `acquire(timeout=...)` and
+    `acquire(False)` are recognized as BOUNDED and never flagged
+    (the PR-6 `emergency_save` fix must not be a false positive)
+  * file IO: `open(...)`, `os.makedirs/replace/rename/remove/fsync`,
+    `shutil.rmtree` — a hung filesystem turns the lock into a wedge
+
+Held-lock regions are tracked both through `with <lock>:` blocks and
+through linear `x.acquire(...)` / `x.release()` flow in one function
+body (the try/finally idiom). "Lock-like" is a name heuristic
+(`lock`/`mutex`/`cv`/`cond`/`sem` in the last name component) — the
+same objects the runtime wrappers instrument.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .diagnostics import Report
+from .preflight import _walk_no_nested_defs
+
+# runtime re-exports: one import surface for the whole family
+from ..monitor.sanitize import (  # noqa: F401
+    SanLock, lock, condition, check_lock_order, lock_order_edges,
+    thread_census)
+
+__all__ = ["lint_locks_source", "is_lockish", "SanLock", "lock",
+           "condition", "check_lock_order", "lock_order_edges",
+           "thread_census"]
+
+_LOCKISH = re.compile(r"(?i)(lock|mutex|cond|sem|(^|_)cv$)")
+
+_OS_BLOCKING = {"makedirs", "replace", "rename", "remove", "fsync",
+                "rmdir"}
+
+
+def _last_component(expr):
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def is_lockish(expr):
+    """Heuristic: does this expression look like a lock/condition?"""
+    name = _last_component(expr)
+    return bool(name and _LOCKISH.search(name))
+
+
+def _key(expr):
+    """Stable identity for an expression (compare `self._cv` across
+    statements)."""
+    try:
+        return ast.dump(expr)
+    except Exception:
+        return repr(expr)
+
+
+def _call_timeout_bounded(call):
+    """True when an acquire/wait/join call is bounded: any positional
+    argument (a timeout, or `False` non-blocking), a `timeout=` /
+    `blocking=False` keyword, or any non-literal argument (assume the
+    author bounded it — false positives erode trust in the pass)."""
+    if call.args:
+        return True
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _flag_blocking_calls(stmt, held, report, filename):
+    """Report blocking calls inside `stmt` while `held` (set of lock
+    expr keys) is non-empty."""
+    nodes = [stmt] if isinstance(stmt, ast.Call) else []
+    nodes.extend(_walk_no_nested_defs(stmt))
+    for n in nodes:
+        if not isinstance(n, ast.Call):
+            continue
+        line = getattr(n, "lineno", stmt.lineno)
+        func = n.func
+        attr = func.attr if isinstance(func, ast.Attribute) else None
+        fname = func.id if isinstance(func, ast.Name) else None
+        if attr == "join" and not n.args and not n.keywords:
+            report.add(
+                "PTA062",
+                "unbounded .join() under a held lock — a wedged "
+                "thread deadlocks every waiter; join(timeout=...) "
+                "and recheck, or join outside the lock",
+                file=filename, line=line, analyzer="concurrency")
+        elif (fname == "sleep"
+              or (attr == "sleep" and isinstance(func.value, ast.Name)
+                  and func.value.id == "time")):
+            report.add(
+                "PTA062",
+                "sleep under a held lock stalls every other waiter "
+                "for the full duration — sleep outside the critical "
+                "section",
+                file=filename, line=line, analyzer="concurrency")
+        elif attr == "wait" and not _call_timeout_bounded(n):
+            # cv.wait() inside `with cv:` RELEASES the lock — the
+            # normal condition idiom; only flag waits on OTHER objects
+            if isinstance(func, ast.Attribute) \
+                    and _key(func.value) not in held:
+                report.add(
+                    "PTA062",
+                    "unbounded .wait() on a foreign object under a "
+                    "held lock — the notifier may need the lock you "
+                    "hold; wait(timeout=...) and recheck",
+                    file=filename, line=line, analyzer="concurrency")
+        elif attr == "acquire" and not _call_timeout_bounded(n):
+            if isinstance(func, ast.Attribute) \
+                    and _key(func.value) in held:
+                report.add(
+                    "PTA062",
+                    "re-acquiring an already-held non-reentrant "
+                    "lock — self-deadlock",
+                    file=filename, line=line, analyzer="concurrency")
+            else:
+                report.add(
+                    "PTA062",
+                    "nested unbounded .acquire() under a held lock "
+                    "builds a deadlock-capable lock order — use "
+                    "acquire(timeout=...) (the bounded-acquire "
+                    "pattern) or order the locks globally",
+                    file=filename, line=line, analyzer="concurrency")
+        elif fname == "open":
+            report.add(
+                "PTA062",
+                "file IO (open) under a held lock — a hung "
+                "filesystem wedges the lock for every waiter; "
+                "stage IO outside, or bound every other path into "
+                "this lock with acquire(timeout=...)",
+                file=filename, line=line, analyzer="concurrency")
+        elif (attr in _OS_BLOCKING and isinstance(func.value, ast.Name)
+              and func.value.id == "os") or \
+             (attr == "rmtree" and isinstance(func.value, ast.Name)
+              and func.value.id == "shutil"):
+            report.add(
+                "PTA062",
+                f"file IO ({func.value.id}.{attr}) under a held "
+                "lock — a hung filesystem wedges the lock for every "
+                "waiter",
+                file=filename, line=line, analyzer="concurrency")
+
+
+def _acquires_releases(stmt):
+    """Lock expr keys this statement acquires / releases anywhere
+    inside it (linear-flow tracking for the try/finally idiom)."""
+    acq, rel = set(), set()
+    for n in _walk_no_nested_defs(stmt):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr == "acquire" and is_lockish(n.func.value):
+                acq.add(_key(n.func.value))
+            elif n.func.attr == "release" \
+                    and is_lockish(n.func.value):
+                rel.add(_key(n.func.value))
+    return acq, rel
+
+
+def _scan_body(body, held, report, filename):
+    """Linear scan of one statement list. `held` is the set of
+    lock-expression keys held entering the list; returns the set held
+    on exit (acquire/release flow)."""
+    held = set(held)
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs run later, under their own locks
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            lock_keys = {_key(i.context_expr) for i in stmt.items
+                         if is_lockish(i.context_expr)}
+            # non-lock `with` items (files, spans) scan transparently
+            if held or lock_keys:
+                inner = held | lock_keys
+                # flag blocking calls in the with HEADER expressions
+                # only when a lock was already held entering it
+                if held:
+                    for item in stmt.items:
+                        _flag_blocking_calls(item.context_expr, held,
+                                             report, filename)
+                _scan_body(stmt.body, inner, report, filename)
+            else:
+                _scan_body(stmt.body, held, report, filename)
+            continue
+        if isinstance(stmt, ast.Try):
+            h = _scan_body(stmt.body, held, report, filename)
+            for handler in stmt.handlers:
+                _scan_body(handler.body, h, report, filename)
+            h = _scan_body(stmt.orelse, h, report, filename)
+            held = _scan_body(stmt.finalbody, h, report, filename)
+            continue
+        if isinstance(stmt, (ast.If, ast.For, ast.While)):
+            if held:
+                # flag only the header expression here — bodies are
+                # scanned below (double-reporting otherwise)
+                header = (stmt.test if isinstance(stmt,
+                                                  (ast.If, ast.While))
+                          else stmt.iter)
+                _flag_blocking_calls(header, held, report, filename)
+            for sub in (stmt.body, stmt.orelse):
+                _scan_body(sub, held, report, filename)
+            # approximate: a branch's acquires publish to the rest of
+            # the body (the `if not x.acquire(timeout=): raise` idiom
+            # means fallthrough HOLDS the lock)
+            acq, rel = _acquires_releases(stmt)
+            held = (held | acq) - rel
+            continue
+        if held:
+            _flag_blocking_calls(stmt, held, report, filename)
+        acq, rel = _acquires_releases(stmt)
+        held = (held | acq) - rel
+    return held
+
+
+def lint_locks_source(source, filename="<string>", report=None):
+    """Static blocking-under-lock pass over one source file."""
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return report  # preflight reports the parse error
+    for fdef in ast.walk(tree):
+        if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_body(fdef.body, set(), report, filename)
+    return report

@@ -81,6 +81,62 @@ DIAGNOSTICS = {
     "PTA034": (Severity.WARNING,
                "host sync (.numpy()/.item()) in traced code",
                "keep values on device; sync only outside the step"),
+    # -- sanitizer suite (static passes + PADDLE_SANITIZE runtime) --
+    "PTA040": (Severity.WARNING,
+               "donation aliasing hazard (donated arg returned, "
+               "captured as const, or reused after the donating call)",
+               "drop retained references to donated buffers; use the "
+               "program's returned value instead"),
+    "PTA041": (Severity.ERROR,
+               "use-after-donate: deleted buffer used after its "
+               "donating dispatch",
+               "adopt the sibling compiler's live state / re-fetch "
+               "the updated array instead of the donated original"),
+    "PTA042": (Severity.ERROR,
+               "input_output_aliases audit failure (shape/dtype "
+               "mismatch or duplicate/out-of-range alias)",
+               "alias only same-shape/dtype operand/result pairs, "
+               "each output at most once"),
+    "PTA043": (Severity.ERROR,
+               "host snapshot does not own its memory (zero-copy "
+               "view of a live device buffer)",
+               "np.array(...) (owned copy), never np.asarray, before "
+               "the next donating dispatch"),
+    "PTA050": (Severity.ERROR,
+               "PartitionSpec names an unknown or repeated mesh axis",
+               "use axes the live mesh defines, each at most once "
+               "(filter_spec silently REPLICATES unknown axes)"),
+    "PTA051": (Severity.ERROR,
+               "dim size not divisible by the mesh axes sharding it",
+               "pad the dim or reshape the mesh so the shard divides"),
+    "PTA052": (Severity.ERROR,
+               "batch_specs/sharding arity mismatch with the program "
+               "inputs",
+               "one spec per batch element, spec rank <= array rank; "
+               "donated inputs must already carry the compiled "
+               "sharding"),
+    "PTA053": (Severity.WARNING,
+               "spec silently replicates a large parameter on a "
+               "model-parallel mesh",
+               "give the parameter a dist_spec over the model axes "
+               "(or accept the HBM cost explicitly)"),
+    "PTA060": (Severity.ERROR,
+               "potential deadlock: lock-acquisition-order cycle",
+               "impose one global lock order or drop the inner lock "
+               "before blocking"),
+    "PTA061": (Severity.WARNING,
+               "lock held across blocking work (timed hold over "
+               "threshold)",
+               "move IO/joins/sleeps outside the critical section"),
+    "PTA062": (Severity.WARNING,
+               "blocking call (join/sleep/wait/IO/bare acquire) "
+               "under a held lock",
+               "use bounded acquire(timeout=...)/wait(timeout) or "
+               "move the blocking call outside the lock"),
+    "PTA063": (Severity.WARNING,
+               "non-daemon thread still alive at exit/close",
+               "join worker threads in close(); daemonize pure "
+               "observers"),
 }
 
 

@@ -1,0 +1,32 @@
+"""`paddle_tpu.analysis.sanitize` — the runtime sanitizer surface.
+
+The implementation lives in `paddle_tpu.monitor.sanitize` (the
+monitor layer sits below jit/io/elastic in the import graph, so the
+adopting modules can gate their hot paths on its module flags the
+same way they gate on `chaos._armed`). This shim is the
+analysis-namespace face of the same module: arm with
+`PADDLE_SANITIZE=donation,locks,sharding` (or
+`analysis.sanitize.configure("locks:hold_ms=250")`), read findings
+through the usual Finding/Report machinery.
+
+Static passes (no arming needed) live beside this module:
+`analysis.donation`, `analysis.sharding`, `analysis.concurrency` —
+and run from the CLI via `python -m paddle_tpu.analysis --sanitize`.
+"""
+from __future__ import annotations
+
+from ..monitor.sanitize import (  # noqa: F401
+    FAMILIES, PARAMS, SanLock, armed, check_args, check_lock_order,
+    clear_findings, condition, configure, describe, disarm,
+    explain_deleted, families, findings, lock, lock_order_edges,
+    note_donated, parse_spec, thread_census, verify_host_tree,
+    verify_owned,
+)
+
+__all__ = [
+    "FAMILIES", "PARAMS", "SanLock", "armed", "check_args",
+    "check_lock_order", "clear_findings", "condition", "configure",
+    "describe", "disarm", "explain_deleted", "families", "findings",
+    "lock", "lock_order_edges", "note_donated", "parse_spec",
+    "thread_census", "verify_host_tree", "verify_owned",
+]

@@ -1,0 +1,293 @@
+"""PTA05x sharding-spec lints — validate hand-written layouts BEFORE
+compile.
+
+Hand-picked `batch_specs`/PartitionSpecs fail late and badly: an axis
+name the mesh doesn't define is silently DROPPED by
+`jit.distributed.filter_spec` (the array quietly replicates), an
+indivisible dim or a missing spec entry only explodes inside
+dispatch, and a large parameter left replicated on a model-parallel
+mesh wastes HBM invisibly. These lints are the cheap static validity
+gate the ROADMAP item-3 sharding planner sweeps need before any
+profile-measure — and they run automatically inside
+`DistributedTrainStepCompiler` builds under `PADDLE_ANALYSIS=1`
+(report-only) or `PADDLE_SANITIZE=sharding` (error findings raise
+before compile).
+
+Codes: PTA050 unknown/repeated mesh axis (error), PTA051 indivisible
+dim (error), PTA052 arity/rank/donated-sharding mismatch (error),
+PTA053 large parameter silently replicated (warning).
+"""
+from __future__ import annotations
+
+import ast
+import math
+import sys
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .diagnostics import Report
+
+__all__ = ["check_spec", "check_batch_specs",
+           "check_replicated_params", "check_compiler",
+           "lint_sharding_source"]
+
+# a "large" parameter for the PTA053 silent-replication lint
+REPLICATION_THRESHOLD_BYTES = 1 << 20
+
+
+def _spec_entries(spec):
+    """PartitionSpec/tuple/list/None -> list of per-dim entries."""
+    if spec is None:
+        return []
+    return list(spec)
+
+
+def _entry_axes(entry):
+    if entry is None:
+        return []
+    if isinstance(entry, (tuple, list)):
+        return [a for a in entry if a is not None]
+    return [entry]
+
+
+def check_spec(spec, shape, mesh_axes, *, name="array", where="",
+               report=None):
+    """Validate ONE PartitionSpec against an array shape and the live
+    mesh axes ({axis: size})."""
+    report = report if report is not None else Report()
+    tag = f"{where}: " if where else ""
+    entries = _spec_entries(spec)
+    shape = tuple(int(d) for d in (shape or ()))
+    if len(entries) > len(shape):
+        report.add(
+            "PTA052",
+            f"{tag}spec for {name} has {len(entries)} entries but "
+            f"the array is rank {len(shape)} (shape {shape}) — "
+            "extra entries fail at dispatch",
+            analyzer="sharding")
+    seen = set()
+    for dim, entry in enumerate(entries):
+        divisor = 1
+        for axis in _entry_axes(entry):
+            if axis not in mesh_axes:
+                report.add(
+                    "PTA050",
+                    f"{tag}spec for {name} names mesh axis "
+                    f"{axis!r} the mesh does not define (axes: "
+                    f"{sorted(mesh_axes)}) — filter_spec silently "
+                    "DROPS it, so the dim replicates instead of "
+                    "sharding",
+                    analyzer="sharding")
+                continue
+            if axis in seen:
+                report.add(
+                    "PTA050",
+                    f"{tag}spec for {name} uses mesh axis {axis!r} "
+                    "on more than one dim — an axis can shard at "
+                    "most one dim",
+                    analyzer="sharding")
+            seen.add(axis)
+            divisor *= int(mesh_axes[axis])
+        if divisor > 1 and dim < len(shape) \
+                and shape[dim] % divisor != 0:
+            report.add(
+                "PTA051",
+                f"{tag}dim {dim} of {name} has size {shape[dim]}, "
+                f"not divisible by the mesh axes sharding it "
+                f"(product {divisor}) — XLA rejects the layout at "
+                "dispatch",
+                analyzer="sharding")
+    return report
+
+
+def check_batch_specs(mesh_axes, batch_specs, batch_shapes,
+                      report=None, where="batch_specs", k=1):
+    """Validate user `batch_specs` against the actual batch. With
+    steps_per_dispatch K>1 each element carries a leading K axis the
+    compiler keeps unsharded; the user spec describes ONE microbatch,
+    so validation strips that axis first."""
+    report = report if report is not None else Report()
+    if batch_specs is None:
+        return report
+    n = len(batch_shapes)
+    if len(batch_specs) < n:
+        report.add(
+            "PTA052",
+            f"{where}: {len(batch_specs)} spec(s) for {n} batch "
+            "element(s) — the missing entries IndexError at "
+            "dispatch time",
+            analyzer="sharding")
+    for i, shape in enumerate(batch_shapes):
+        if i >= len(batch_specs):
+            break
+        shape = tuple(int(d) for d in shape)
+        if k > 1:
+            shape = shape[1:]  # leading K axis stays unsharded
+        check_spec(batch_specs[i], shape, mesh_axes,
+                   name=f"batch element {i}", where=where,
+                   report=report)
+    return report
+
+
+def check_replicated_params(mesh_axes, named_params, report=None,
+                            threshold=None, where="params"):
+    """PTA053: a parameter past `threshold` bytes with no (effective)
+    dist_spec on a mesh that HAS model-parallel capacity (any non-dp
+    axis > 1) is silently replicated onto every device — legal, but
+    the kind of HBM bill that should be explicit."""
+    report = report if report is not None else Report()
+    threshold = (REPLICATION_THRESHOLD_BYTES if threshold is None
+                 else int(threshold))
+    model_par = math.prod(
+        int(s) for a, s in mesh_axes.items() if a != "dp") > 1
+    if not model_par:
+        return report  # pure-dp replication is the normal contract
+    for name, p in named_params:
+        try:
+            v = getattr(p, "_value", p)
+            nbytes = int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+        except Exception:
+            continue
+        if nbytes < threshold:
+            continue
+        spec = getattr(p, "dist_spec", None)
+        axes = [a for e in _spec_entries(spec)
+                for a in _entry_axes(e) if a in mesh_axes]
+        if not axes:
+            report.add(
+                "PTA053",
+                f"{where}: parameter '{name}' "
+                f"({nbytes / (1 << 20):.1f} MiB) has no dist_spec "
+                "and will be REPLICATED onto every device of a "
+                "model-parallel mesh — shard it or accept the HBM "
+                "cost explicitly",
+                analyzer="sharding")
+    return report
+
+
+def check_compiler(compiler, batch, report=None, record=True,
+                   emit=True):
+    """Full PTA05x sweep over one DistributedTrainStepCompiler just
+    before its first build: batch specs vs the live batch, parameter
+    dist_specs vs the mesh, donated-input shardings vs the planned
+    in_shardings, large-replication audit. Report-only — the caller
+    decides whether errors abort the build (PADDLE_SANITIZE=sharding
+    does)."""
+    report = report if report is not None else Report()
+    mesh = compiler._mesh
+    mesh_axes = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    k = getattr(compiler, "_steps_per_dispatch", 1)
+    shapes = []
+    for b in batch:
+        v = b._value if isinstance(b, Tensor) else b
+        shapes.append(tuple(np.shape(v)))
+    where = f"train_step:{type(compiler._model).__name__}"
+    check_batch_specs(mesh_axes, compiler._batch_specs, shapes,
+                      report=report, where=f"{where} batch_specs",
+                      k=k)
+    if compiler._batch_specs is None and "dp" in mesh_axes \
+            and mesh_axes["dp"] > 1:
+        # the default layout shards the leading microbatch dim on dp
+        for i, shape in enumerate(shapes):
+            s = shape[1:] if k > 1 else shape
+            if s and s[0] % mesh_axes["dp"] != 0:
+                report.add(
+                    "PTA051",
+                    f"{where}: batch element {i} leading dim "
+                    f"{s[0]} is not divisible by dp="
+                    f"{mesh_axes['dp']} (default dp sharding)",
+                    analyzer="sharding")
+    named = list(compiler._model.named_parameters())
+    for name, p in named:
+        spec = getattr(p, "dist_spec", None)
+        if spec is not None:
+            check_spec(spec, tuple(p._value.shape), mesh_axes,
+                       name=f"parameter '{name}'", where=where,
+                       report=report)
+        sspec = getattr(p, "slot_dist_spec", None)
+        if sspec is not None:
+            check_spec(sspec, tuple(p._value.shape), mesh_axes,
+                       name=f"slot spec of '{name}'", where=where,
+                       report=report)
+    check_replicated_params(mesh_axes, named, report=report,
+                            where=where)
+    # donated-input sharding mismatch: params are donated (argnum 0);
+    # a live value whose sharding differs from the planned
+    # in_sharding forces a resharding copy, so the donation cannot
+    # alias — worst case a silent perf cliff, on reshaped meshes a
+    # dispatch-time error
+    try:
+        from jax.sharding import NamedSharding
+
+        if compiler._sharded_params:
+            for name, p in named:
+                if not getattr(p, "trainable", True):
+                    continue
+                live = getattr(p._value, "sharding", None)
+                want = compiler._param_sharding(p)
+                if isinstance(live, NamedSharding) \
+                        and tuple(live.spec) != tuple(want.spec):
+                    report.add(
+                        "PTA052",
+                        f"{where}: donated parameter '{name}' is "
+                        f"live-sharded {tuple(live.spec)} but the "
+                        f"program compiles for {tuple(want.spec)} "
+                        "— donation cannot alias across the "
+                        "resharding copy",
+                        analyzer="sharding")
+    except Exception:
+        pass
+    if emit and report.findings:
+        print(f"[paddle_tpu.analysis] sharding lints ({where}):",
+              file=sys.stderr)
+        for f in report.sorted():
+            print(f"  {f.format()}", file=sys.stderr)
+    if record:
+        report.record()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# AST pass (CLI --sanitize sharding)
+# ---------------------------------------------------------------------------
+
+def lint_sharding_source(source, filename="<string>", report=None):
+    """Source-level PartitionSpec lint: a `P(...)` /
+    `PartitionSpec(...)` literal that repeats an axis name across its
+    dims is invalid on EVERY mesh — no live mesh needed to reject
+    it."""
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return report
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = (node.func.id if isinstance(node.func, ast.Name)
+                 else node.func.attr
+                 if isinstance(node.func, ast.Attribute) else None)
+        if fname not in ("P", "PartitionSpec"):
+            continue
+        seen = set()
+        for arg in node.args:
+            names = []
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                names = [arg.value]
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                names = [e.value for e in arg.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+            for n in names:
+                if n in seen:
+                    report.add(
+                        "PTA050",
+                        f"PartitionSpec repeats mesh axis {n!r} "
+                        "across dims — an axis can shard at most "
+                        "one dim (invalid on every mesh)",
+                        file=filename, line=node.lineno,
+                        analyzer="sharding")
+                seen.add(n)
+    return report

@@ -71,6 +71,7 @@ from ...core import monitor as _cmon
 from ...core.tensor import Tensor
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import sanitize as _sanitize
 from ...monitor.flight import _env_float, _env_int, _env_on
 
 __all__ = ["CheckpointManager", "SCHEMA", "default_checkpoint_dir"]
@@ -284,7 +285,10 @@ class CheckpointManager:
         self.global_step = 0     # completed optimizer microsteps
         self.cursor = None       # set by restore(): where to resume
         self.preempted = threading.Event()
-        self._cv = threading.Condition()
+        # sanitize-aware primitives (PADDLE_SANITIZE=locks): plain
+        # threading objects when disarmed, instrumented wrappers
+        # feeding the PTA060 lock-order graph when armed
+        self._cv = _sanitize.condition("ckpt.cv")
         self._pending = None     # latest-wins (host_tree, meta) slot
         self._busy = False
         self._writer = None
@@ -298,7 +302,7 @@ class CheckpointManager:
         self._preempt_grace_s = 10.0  # window for the loop's own save
         self._lock_timeout_s = 15.0   # bounded waits vs wedged writer
         self._closing = threading.Event()  # close() in progress
-        self._write_lock = threading.Lock()  # writer vs emergency
+        self._write_lock = _sanitize.lock("ckpt.writer")  # vs emergency
 
     # -- cadence ----------------------------------------------------------
     def due(self, global_step):
@@ -342,6 +346,13 @@ class CheckpointManager:
         g = self.global_step if global_step is None else int(global_step)
         specs = {}
         host = _hostify(state, specs)
+        if _sanitize._donation:
+            # PTA043: verify the hostified snapshot OWNS its memory —
+            # a zero-copy view of a live device buffer (the PR-6
+            # np.asarray bug) would be mutated by the next dispatch's
+            # donation while the writer still holds it
+            host = _sanitize.verify_host_tree(
+                host, site="ckpt.save", what="checkpoint snapshot")
         meta = {"schema": SCHEMA, "step": g, "epoch": int(epoch),
                 "step_in_epoch": int(step_in_epoch),
                 "ts": round(time.time(), 3),
@@ -466,7 +477,10 @@ class CheckpointManager:
         try:
             with _flight.in_flight("ckpt_write", f"step_{g}"):
                 d = self._step_dir(g)
-                os.makedirs(d, exist_ok=True)
+                # IO under _write_lock is this lock's PURPOSE (one
+                # writer per snapshot dir); every other path into it
+                # uses the bounded acquire(timeout=) above
+                os.makedirs(d, exist_ok=True)  # noqa: PTA062
                 payload = pickle.dumps(
                     {"schema": SCHEMA, "state": host}, protocol=4)
                 # chaos site "ckpt_write": enospc/delay/stall enact
@@ -478,7 +492,7 @@ class CheckpointManager:
                 if _chaos._armed:
                     act = _chaos.hit("ckpt_write", step=g)
                     if act is not None and act.fault == "torn":
-                        with open(os.path.join(
+                        with open(os.path.join(  # noqa: PTA062 — chaos-injected torn write, deliberately under the writer lock
                                 d, f"state_rank{self.rank}.pd"),
                                 "wb") as fh:
                             fh.write(payload[:max(1,
@@ -583,6 +597,10 @@ class CheckpointManager:
                 state, cur = prov()
                 specs = {}
                 host = _hostify(state, specs)
+                if _sanitize._donation:
+                    host = _sanitize.verify_host_tree(
+                        host, site="ckpt.emergency_save",
+                        what="emergency snapshot")
                 meta = {"schema": SCHEMA,
                         "step": int(cur.get("global_step", 0)),
                         "epoch": int(cur.get("epoch", 0)),

@@ -104,57 +104,107 @@ def _chunk_spec():
                         lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
 
 
+def _audit_aliases(aliases, ins, out_shape, where):
+    """PTA042 audit of a packer's hand-built input_output_aliases
+    against the actual operands/results — opt-in (PADDLE_ANALYSIS=1
+    or PADDLE_SANITIZE=donation), so a future edit to the pack math
+    fails as a named finding instead of an XLA layout error."""
+    from ....analysis import enabled as _analysis_enabled
+    from ....monitor import sanitize as _sanitize
+
+    if not (_analysis_enabled() or _sanitize._donation):
+        return
+    from ....analysis.donation import audit_aliases
+
+    outs = (out_shape if isinstance(out_shape, (tuple, list))
+            and not hasattr(out_shape, "shape") else (out_shape,))
+    report = audit_aliases(
+        aliases,
+        [tuple(a.shape) for a in ins],
+        [tuple(o.shape) for o in outs],
+        in_dtypes=[str(a.dtype) for a in ins],
+        out_dtypes=[str(o.dtype) for o in outs],
+        where=where)
+    if report.findings:
+        import sys
+
+        for f in report.sorted():
+            print(f"[paddle_tpu.analysis] {f.format()}",
+                  file=sys.stderr)
+        report.record()
+        if _sanitize._donation:
+            raise ValueError(
+                f"PTA042 input_output_aliases audit failed in "
+                f"{where}:\n"
+                + "\n".join(f.format() for f in report.findings))
+
+
 def fused_adam_chunks(p, g, m, v, lr, d1, d2, wd, *, beta1, beta2, eps,
                       wd_coupled=0.0, interpret=False):
     """One launch of the fused Adam/AdamW rule over (G, R, 128) chunk
     buffers; d1/d2/wd are (G, 1) per-chunk scalars. Returns
     (new_p, new_m, new_v)."""
     G = p.shape[0]
+    # ONE aliases/operands/out_shape triple shared by the audit and
+    # the launch — the audit must check exactly what XLA gets
+    aliases = {4: 0, 6: 1, 7: 2}
+    operands = (lr.reshape(1, 1), d1, d2, wd, p, g, m, v)
+    out_shape = (jax.ShapeDtypeStruct(p.shape, p.dtype),) * 3
+    _audit_aliases(aliases, operands, out_shape, "fused_adam_chunks")
     kernel = functools.partial(_adam_kernel, b1=beta1, b2=beta2,
                                eps=eps, wdc=wd_coupled)
     return pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct(p.shape, p.dtype),) * 3,
+        out_shape=out_shape,
         grid=(G,),
         in_specs=[_scalar_spec(), _chunk_scalar_spec(),
                   _chunk_scalar_spec(), _chunk_scalar_spec(),
                   _chunk_spec(), _chunk_spec(), _chunk_spec(),
                   _chunk_spec()],
         out_specs=(_chunk_spec(),) * 3,
-        input_output_aliases={4: 0, 6: 1, 7: 2},
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(lr.reshape(1, 1), d1, d2, wd, p, g, m, v)
+    )(*operands)
 
 
 def fused_sgd_chunks(p, g, lr, *, wd_coupled=0.0, interpret=False):
     G = p.shape[0]
+    aliases = {1: 0}
+    operands = (lr.reshape(1, 1), p, g)
+    out_shape = jax.ShapeDtypeStruct(p.shape, p.dtype)
+    _audit_aliases(aliases, operands, out_shape, "fused_sgd_chunks")
     kernel = functools.partial(_sgd_kernel, wdc=wd_coupled)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(p.shape, p.dtype),
+        out_shape=out_shape,
         grid=(G,),
         in_specs=[_scalar_spec(), _chunk_spec(), _chunk_spec()],
         out_specs=_chunk_spec(),
-        input_output_aliases={1: 0},
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(lr.reshape(1, 1), p, g)
+    )(*operands)
 
 
 def fused_momentum_chunks(p, g, v, lr, *, momentum, nesterov=False,
                           wd_coupled=0.0, interpret=False):
     G = p.shape[0]
+    aliases = {1: 0, 3: 1}
+    operands = (lr.reshape(1, 1), p, g, v)
+    out_shape = (jax.ShapeDtypeStruct(p.shape, p.dtype),) * 2
+    _audit_aliases(aliases, operands, out_shape,
+                   "fused_momentum_chunks")
     kernel = functools.partial(_momentum_kernel, mu=momentum,
                                nesterov=nesterov, wdc=wd_coupled)
     return pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct(p.shape, p.dtype),) * 2,
+        out_shape=out_shape,
         grid=(G,),
         in_specs=[_scalar_spec(), _chunk_spec(), _chunk_spec(),
                   _chunk_spec()],
         out_specs=(_chunk_spec(),) * 2,
-        input_output_aliases={1: 0, 3: 1},
+        input_output_aliases=aliases,
         interpret=interpret,
-    )(lr.reshape(1, 1), p, g, v)
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,7 @@ import threading
 import numpy as np
 
 from ..monitor import chaos as _chaos
+from ..monitor import sanitize as _sanitize
 
 _EOF = b"\x00PDEOF"
 _ERR = b"\x00PDERR"
@@ -234,7 +235,7 @@ def _decode_view(view):
     return pickle.loads(meta, buffers=bufs)
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = _sanitize.lock("io.shm_lib")
 
 
 def _ring_lib():

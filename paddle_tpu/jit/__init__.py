@@ -30,6 +30,7 @@ from ..core import monitor as _monitor
 from ..core.tensor import Tensor
 from ..monitor import chaos as _chaos
 from ..monitor import flight as _flight
+from ..monitor import sanitize as _sanitize
 from ..ops import random as _random
 from . import persistent_cache as _pcache
 from . import state as _jstate
@@ -1079,12 +1080,42 @@ class TrainStepCompiler:
         fvals = {k: p._value for k, p in frozen.items()}
         bvals = {k: b._value for k, b in bufs.items()}
         avals = self._place_batch(batch)
+        # PTA04x donation sanitizer (PADDLE_SANITIZE=donation): scan
+        # the dispatch inputs for already-deleted donated buffers
+        # BEFORE XLA sees them — a stale reference fed back in (the
+        # PR-8 clobbered-_jit_step shape) raises a PTA041 report
+        # naming the donating dispatch instead of the opaque
+        # "buffer has been deleted" crash
+        san_site = None
+        if _sanitize._donation:
+            san_site = (f"train_step:{type(self._model).__name__}"
+                        f" dispatch#{self._step}")
+            _sanitize.check_args(
+                (pvals, self._opt_state, self._accum_state, fvals,
+                 bvals, avals), site=san_site)
         # host scalars (jit globalizes them under any mesh/process set)
         lr = np.float32(self._opt.get_lr())
         rngc = np.uint32(self._step)
-        new_p, new_opt, new_acc, new_b, loss, skips = self._compiled(
-            pvals, self._opt_state, self._accum_state, fvals, bvals,
-            avals, lr, rngc, self._loss_scale())
+        prev_opt, prev_acc = self._opt_state, self._accum_state
+        try:
+            new_p, new_opt, new_acc, new_b, loss, skips = \
+                self._compiled(
+                    pvals, self._opt_state, self._accum_state, fvals,
+                    bvals, avals, lr, rngc, self._loss_scale())
+        except RuntimeError as e:
+            if _sanitize._donation:
+                better = _sanitize.explain_deleted(
+                    e, site=san_site or "train_step dispatch")
+                if better is not None:
+                    raise better from e
+            raise
+        if _sanitize._donation and self._donate:
+            # the program just donated argnums (0, 1, 2): register
+            # the OLD params/opt-state/accumulators with this
+            # dispatch site so any later use of a retained reference
+            # reports PTA041 with both ends named
+            _sanitize.note_donated((pvals, prev_opt, prev_acc),
+                                   site=san_site)
         self._opt_state = new_opt
         self._accum_state = new_acc
         for k, p in trainable.items():

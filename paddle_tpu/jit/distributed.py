@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
+from ..monitor import sanitize as _sanitize
 from . import TrainStepCompiler
 
 __all__ = ["DistributedTrainStepCompiler", "filter_spec"]
@@ -185,7 +186,30 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                 tuple(int(m.shape[a]) for a in m.axis_names),
                 tuple(str(d) for d in np.ravel(m.devices)))
 
+    def _lint_shardings(self, batch):
+        """PTA05x sharding-spec lints just before the first compile:
+        hand-written batch_specs/dist_specs that name unknown mesh
+        axes (silently replicated by filter_spec), don't divide their
+        dims, miss batch elements, or leave large parameters
+        replicated on a model-parallel mesh — caught here instead of
+        at dispatch. Report-only under PADDLE_ANALYSIS=1;
+        PADDLE_SANITIZE=sharding makes error findings abort the
+        build."""
+        from ..analysis import enabled as _analysis_enabled
+
+        if not (_sanitize._sharding or _analysis_enabled()):
+            return
+        from ..analysis import sharding as _shlint
+
+        report = _shlint.check_compiler(self, batch)
+        if _sanitize._sharding and report.errors:
+            raise ValueError(
+                "PTA05x sharding-spec lint failed "
+                "(PADDLE_SANITIZE=sharding):\n"
+                + "\n".join(f.format() for f in report.errors))
+
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
+        self._lint_shardings(batch)
         mesh = self._mesh
         repl = NamedSharding(mesh, P())
         param_sh = {k: self._param_sharding(p)

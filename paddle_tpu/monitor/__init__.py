@@ -60,6 +60,7 @@ from ..core.monitor import (  # noqa: F401 — the counter surface
 from . import flight  # noqa: E402 — the failure-forensics leg
 from . import memory  # noqa: E402 — the device-memory leg
 from . import chaos  # noqa: E402 — deterministic fault injection
+from . import sanitize  # noqa: E402 — runtime sanitizer core (ISSUE 10)
 
 __all__ = [
     "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
@@ -349,7 +350,7 @@ class MetricsExporter:
 
 
 _exporter = None
-_exporter_lock = threading.Lock()
+_exporter_lock = sanitize.lock("monitor.exporter")
 
 
 def get_exporter():

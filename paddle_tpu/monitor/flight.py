@@ -59,6 +59,7 @@ import traceback
 from collections import deque
 
 from ..core import monitor as _cmon
+from . import sanitize as _sanitize
 
 __all__ = [
     "DUMP_SCHEMA", "FlightRecorder", "recorder", "record", "tail",
@@ -173,7 +174,9 @@ class FlightRecorder:
         if enabled is None:
             enabled = _env_on("PADDLE_FLIGHT_ENABLE", True)
         self._ring = deque(maxlen=max(16, int(capacity)))
-        self._lock = threading.Lock()
+        # sanitize-aware (PADDLE_SANITIZE=locks): a plain Lock when
+        # disarmed — record() is the always-on hot path
+        self._lock = _sanitize.lock("flight.ring")
         self._seq = 0
         self._dropped = 0
         self.enabled = bool(enabled)
@@ -253,7 +256,7 @@ def sync_stats():
 # ---------------------------------------------------------------------------
 
 _inflight: dict = {}
-_inflight_lock = threading.Lock()
+_inflight_lock = _sanitize.lock("flight.inflight")
 _token_seq = itertools.count(1)
 
 
@@ -389,6 +392,13 @@ def _memory_section(reason, full=None, jit_report=None):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _sanitize_section():
+    try:
+        return _sanitize.describe()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def write_dump(reason, extra=None, path=None, full_memory=None):
     """Write one self-contained JSON forensics bundle and return its
     path. Schema (DUMP_SCHEMA = "paddle_tpu.flight/1"):
@@ -432,6 +442,10 @@ def write_dump(reason, extra=None, path=None, full_memory=None):
         "memory": _memory_section(
             reason, full=full_memory,
             jit_report=caches if isinstance(caches, list) else None),
+        # sanitizer state (ISSUE 10): which families were armed and
+        # what they tracked/found — sanitize_arm/sanitize_finding
+        # events ride the flight_tail, this is the summary
+        "sanitize": _sanitize_section(),
     }
     try:
         from . import telemetry_snapshot
@@ -627,7 +641,7 @@ class Watchdog:
 
 
 _watchdog = None
-_watchdog_lock = threading.Lock()
+_watchdog_lock = _sanitize.lock("flight.watchdog")
 
 
 def get_watchdog():
@@ -918,3 +932,9 @@ def maybe_auto_arm(where=""):
         return None
     recorder.record("auto_arm", where=where)
     return arm()
+
+
+# the PADDLE_SANITIZE env autostart arms from inside this module's own
+# `from . import sanitize` (before the recorder existed) — replay any
+# events it buffered so the sanitize_arm event reaches the ring
+_sanitize.flush_flight_events()

@@ -70,7 +70,8 @@ def load(name, sources, extra_cxx_flags=None, extra_ldflags=None,
             if res.returncode != 0:
                 raise RuntimeError(
                     f"cpp_extension build of {name} failed:\n{res.stderr}")
-            os.replace(tmp, so)
+            # one-time build publish; the lock serializes exactly this
+            os.replace(tmp, so)  # noqa: PTA062
         lib = ctypes.CDLL(so)
         _loaded[key] = lib
         return lib

@@ -23,6 +23,38 @@ def test_analysis_cli_clean_over_package(capsys):
     assert rc == 0, f"self-audit found error-severity findings:\n{out}"
 
 
+def test_analysis_cli_strict_sanitize_clean_over_package():
+    """ISSUE-10 tier-1 gate: `python -m paddle_tpu.analysis
+    paddle_tpu/ --strict --sanitize` — the FULL static suite
+    (preflight + the PTA04x/05x/06x sanitizer passes) runs clean
+    over the whole package, warnings included. New code cannot
+    regress the audit; intentional findings carry inline
+    `# noqa: PTA0xx`."""
+    from paddle_tpu.analysis.cli import main
+
+    rc = main([PKG, "--strict", "--sanitize"])
+    assert rc == 0
+
+
+def test_sanitizer_selfaudit_runtime_dirs():
+    """The sanitizer static passes explicitly walk the directories
+    whose bugs motivated them (monitor/, incubate/checkpoint/, jit/,
+    io/) — zero findings after inline noqa of the intentional ones
+    (e.g. checkpoint IO under the writer lock, which every other
+    path enters through a bounded acquire(timeout=...))."""
+    from paddle_tpu.analysis.cli import (SANITIZE_FAMILIES,
+                                         iter_target_files, lint_file)
+    from paddle_tpu.analysis.diagnostics import Report
+
+    report = Report()
+    for sub in ("monitor", os.path.join("incubate", "checkpoint"),
+                "jit", "io"):
+        for path in iter_target_files(os.path.join(PKG, sub)):
+            lint_file(path, report, sanitize=SANITIZE_FAMILIES)
+    assert not report.findings, \
+        [f.format() for f in report.findings]
+
+
 def test_analysis_jaxpr_selfaudit_vision_models():
     """Deep (traced) half of the self-audit: representative vision
     models must produce no error-severity findings when abstractly
