@@ -46,6 +46,9 @@ from .donation import (audit_aliases, audit_donation,
                        lint_donation_source)
 from .sharding import (check_batch_specs, check_replicated_params,
                        check_spec, lint_sharding_source)
+# serving KV-block accounting (ISSUE 11): PTA07x static half
+from . import serving
+from .serving import audit_block_accounting, lint_kv_source
 
 __all__ = [
     "DIAGNOSTICS", "Finding", "Report", "Severity", "check",
@@ -54,10 +57,11 @@ __all__ = [
     "DeadVarAnalysisPass", "UnfetchedOutputAnalysisPass",
     "OpCoverageAnalysisPass", "is_suppressed", "fn_anchor",
     "collect_comm_ops", "comm_digest", "compare_comm_digests",
-    "sanitize", "donation", "sharding", "concurrency",
+    "sanitize", "donation", "sharding", "concurrency", "serving",
     "audit_donation", "audit_aliases", "lint_donation_source",
     "lint_locks_source", "lint_sharding_source", "check_spec",
     "check_batch_specs", "check_replicated_params",
+    "lint_kv_source", "audit_block_accounting",
 ]
 
 

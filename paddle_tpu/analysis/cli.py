@@ -53,17 +53,19 @@ def iter_target_files(target):
 # the AST halves of the sanitizer suite (runtime halves arm via
 # PADDLE_SANITIZE); import lazily so the bare preflight CLI stays
 # light.
-SANITIZE_FAMILIES = ("donation", "locks", "sharding")
+SANITIZE_FAMILIES = ("donation", "locks", "sharding", "serving")
 
 
 def _sanitize_passes(families):
     from .concurrency import lint_locks_source
     from .donation import lint_donation_source
+    from .serving import lint_kv_source
     from .sharding import lint_sharding_source
 
     table = {"donation": lint_donation_source,
              "locks": lint_locks_source,
-             "sharding": lint_sharding_source}
+             "sharding": lint_sharding_source,
+             "serving": lint_kv_source}
     return [table[f] for f in families]
 
 
@@ -105,8 +107,9 @@ def main(argv=None):
                     metavar="FAMILIES",
                     help="also run the sanitizer static passes "
                          "(PTA04x donation, PTA05x sharding, PTA06x "
-                         "locks); optional comma list "
-                         "donation,locks,sharding (default: all)")
+                         "locks, PTA07x serving); optional comma "
+                         "list donation,locks,sharding,serving "
+                         "(default: all)")
     args = ap.parse_args(argv)
 
     sanitize = ()

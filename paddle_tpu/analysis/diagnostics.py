@@ -137,6 +137,21 @@ DIAGNOSTICS = {
                "non-daemon thread still alive at exit/close",
                "join worker threads in close(); daemonize pure "
                "observers"),
+    "PTA070": (Severity.ERROR,
+               "KV block leak: pool blocks not freed on request "
+               "completion/eviction (or an alloc whose result is "
+               "discarded)",
+               "release(owner) on every terminal request path; "
+               "keep the block ids alloc() returns"),
+    "PTA071": (Severity.ERROR,
+               "KV block double-free or free of an unowned block",
+               "free blocks exactly once, through the owner that "
+               "holds them"),
+    "PTA072": (Severity.WARNING,
+               "request dropped from a running/tracking table "
+               "without a KV release on the same path",
+               "call allocator.release()/scheduler.finish() before "
+               "discarding the request"),
 }
 
 

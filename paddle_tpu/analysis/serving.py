@@ -1,0 +1,131 @@
+"""PTA07x serving KV-block sanitizer — static accounting pass.
+
+Every KV block the serving engine hands a request is HBM a future
+request can't use until it comes back: a leaked block table is a slow
+death for a serving replica (admission control starves at a pool the
+allocator thinks is full). The runtime half of this family lives in
+`inference.serving.kv_cache` (armed by `PADDLE_SANITIZE=serving`):
+double-free / foreign-free reports PTA071 at the faulting call and
+`BlockAllocator.audit_leaks()` / `LLMEngine.check_drained()` report
+PTA070 for blocks owned by requests the engine no longer tracks.
+
+This module is the STATIC half (the CLI `--sanitize serving` leg):
+
+  * a bare-statement `x.alloc(...)` / `x.alloc_blocks(...)` call
+    whose returned block ids are DISCARDED — the caller can never
+    free what it never kept, a guaranteed leak          (PTA070)
+  * a function that drops a request from a running/tracking table
+    (`running.pop(...)` / `del running[...]`) with NO release-family
+    call (`release` / `free_one` / `finish` / `evict` / `abort`)
+    anywhere on the same function body — the request's blocks have
+    no terminal owner                                   (PTA072)
+
+plus `audit_block_accounting(...)`, the programmatic wrapper tests
+and the engine drain path use to turn the runtime allocator state
+into an analysis Report.
+"""
+from __future__ import annotations
+
+import ast
+
+from .diagnostics import Report, Severity
+from .preflight import _walk_no_nested_defs
+
+__all__ = ["lint_kv_source", "audit_block_accounting"]
+
+_ALLOC_NAMES = ("alloc", "alloc_blocks")
+_RELEASE_NAMES = ("release", "free_one", "free", "finish", "evict",
+                  "abort")
+_TRACKING_NAMES = ("running", "_running", "requests", "_requests")
+
+
+def _call_attr(node):
+    """Trailing attribute name of a Call's func, '' otherwise."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+    return ""
+
+
+def _is_tracking(node):
+    """Does this expression name a request-tracking container
+    (`self.running`, `sched._requests`, a bare `running`)?"""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _TRACKING_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in _TRACKING_NAMES
+    return False
+
+
+def lint_kv_source(source, filename="<string>", report=None):
+    """AST pass over one file: discarded alloc results (PTA070) and
+    request-drop-without-release paths (PTA072)."""
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return report
+
+    for node in ast.walk(tree):
+        # discarded alloc result — module/class level included
+        if isinstance(node, ast.Expr) and \
+                _call_attr(node.value) in _ALLOC_NAMES:
+            report.add(
+                "PTA070",
+                f"result of {_call_attr(node.value)}() is discarded "
+                "— the returned block ids are unreachable and can "
+                "never be freed",
+                file=filename, line=node.lineno,
+                severity=Severity.ERROR, analyzer="serving")
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        drops, releases = [], False
+        for sub in _walk_no_nested_defs(node):
+            if isinstance(sub, ast.Call) and \
+                    _call_attr(sub) in _RELEASE_NAMES:
+                releases = True
+            # running.pop(...) — a request leaves the table
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "pop" and \
+                    _is_tracking(sub.func.value):
+                drops.append(sub)
+            # del running[slot]
+            if isinstance(sub, ast.Delete):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            _is_tracking(tgt.value):
+                        drops.append(tgt)
+        if drops and not releases:
+            for d in drops:
+                report.add(
+                    "PTA072",
+                    f"{node.name}: request removed from its "
+                    "tracking table with no release-family call "
+                    "on this path — its KV blocks leak",
+                    file=filename, line=d.lineno,
+                    analyzer="serving")
+    return report
+
+
+def audit_block_accounting(allocator, live_owners=(), report=None,
+                           where=""):
+    """Runtime allocator state -> analysis Report: one PTA070
+    finding per owner holding blocks while absent from
+    `live_owners`. The allocator's own `audit_leaks` also feeds the
+    monitor counters when PADDLE_SANITIZE=serving is armed; this
+    wrapper is the CLI/test-facing Report view."""
+    report = report if report is not None else Report()
+    leaked = allocator.audit_leaks(live_owners)
+    for owner, blocks in sorted(leaked.items(),
+                                key=lambda kv: str(kv[0])):
+        report.add(
+            "PTA070",
+            f"{where or 'allocator'}: {len(blocks)} KV block(s) "
+            f"still owned by finished/unknown request {owner!r}",
+            severity=Severity.ERROR, analyzer="serving")
+    return report

@@ -26,8 +26,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["fusion_enabled", "interpret_mode", "kernels_available",
-           "ln_supported", "layernorm", "optim", "fused_layer_norm",
-           "fused_residual_layer_norm"]
+           "ln_supported", "layernorm", "optim", "paged_attention",
+           "fused_layer_norm", "fused_residual_layer_norm"]
 
 _TPU_PLATFORMS = ("tpu", "axon")
 
@@ -81,7 +81,7 @@ def ln_supported(hidden):
 # the feature off; call sites go through these attributes, which load
 # on first touch (PEP 562)
 def __getattr__(name):
-    if name in ("layernorm", "optim"):
+    if name in ("layernorm", "optim", "paged_attention"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

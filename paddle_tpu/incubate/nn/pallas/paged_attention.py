@@ -1,0 +1,169 @@
+"""Pallas TPU ragged paged-attention decode kernel.
+
+The serving-side sibling of `attention_pallas.py` (PR 8): that kernel
+streams contiguous K/V tiles for TRAINING-shaped batches; this one
+reads K/V through per-request BLOCK TABLES out of the paged pools
+(`inference.serving.kv_cache`), so ONE launch covers every sequence
+in a continuous-batching decode step at mixed context lengths — the
+Ragged Paged Attention design (PAPERS.md arxiv 2604.15464).
+
+Decode shape: one query token per sequence.
+
+    q            [B, H, D]           this step's query rows
+    k/v pool     [N, BS, H, D]       one layer's paged pool
+    block_tables [B, MAXB] int32     pool block id per (seq, slot)
+    context_lens [B]       int32     real tokens per sequence
+
+Grid: (B, MAXB). `block_tables`/`context_lens` ride as SCALAR
+PREFETCH arguments (pltpu.PrefetchScalarGridSpec) so the K/V
+BlockSpec index maps resolve `tables[b, j]` BEFORE the kernel body —
+the DMA engine fetches exactly the blocks each sequence owns, in
+table order, nothing else. Dead blocks (slots past the sequence's
+context length) are grid-skipped with `pl.when`, the pad-and-mask
+discipline the PR-8 flash kernel established: a fully-dead block
+costs its (skipped) grid step, never a matmul; the tail block masks
+`k_pos >= context_len` scores to -inf so padded slots contribute
+exactly zero weight. Online softmax (running max/denominator in VMEM
+scratch) accumulates across a sequence's blocks, so nothing
+[S, S]-shaped ever materializes.
+
+`interpret=True` runs the same kernel through the Pallas interpreter
+for CPU parity tests (the PR-8 contract; see
+`paged_attention_reference` for the dense gather it must match).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention", "paged_attention_reference",
+           "paged_decode_supported"]
+
+_NEG_INF = -1e30
+# running max / denominator ride (H, _STAT_LANES) f32 scratch — the
+# small-lane stats layout attention_pallas.py uses
+_STAT_LANES = 8
+
+
+def paged_decode_supported(head_dim, block_size):
+    """Can the compiled TPU kernel take this geometry here? The MXU
+    wants lane-aligned reduction dims; the interpreter (CPU parity)
+    takes anything."""
+    from . import interpret_mode, kernels_available
+
+    if not kernels_available():
+        return False
+    if interpret_mode():
+        return True
+    return head_dim in (64, 128) and block_size % 8 == 0
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale, block_size,
+                  num_slots):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    ctx = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # grid-skip dead blocks: table slots at or past the context hold
+    # NULL_BLOCK padding — no matmul, no softmax update
+    @pl.when(j * block_size < ctx)
+    def _step():
+        q = q_ref[0]                                   # [H, D]
+        k = jnp.transpose(k_ref[0], (1, 0, 2))         # [H, BS, D]
+        v = jnp.transpose(v_ref[0], (1, 0, 2))         # [H, BS, D]
+        # s[h, t] = q[h, :] . k[h, t, :] — operands stay in the pool
+        # dtype (bf16-native MXU), statistics f32 (the PR-8 rule)
+        s = jax.lax.dot_general(
+            q[:, None, :], k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :]
+        s = s * sm_scale                               # [H, BS]
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < ctx, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                          # [H, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                         # [H, BS]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype)[:, None, :], v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :]
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_slots - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                    sm_scale=1.0, interpret=False):
+    """Ragged paged-attention decode: one launch, all sequences."""
+    b, h, d = q.shape
+    n, bs, hk, dk = k_pool.shape
+    if (hk, dk) != (h, d):
+        raise ValueError(
+            f"pool heads/dim {(hk, dk)} != query {(h, d)}")
+    maxb = block_tables.shape[1]
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=sm_scale, block_size=bs,
+        num_slots=maxb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda i, j, bt, cl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h, _STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(context_lens, jnp.int32), q, k_pool, v_pool)
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables,
+                              context_lens, sm_scale=1.0):
+    """Dense gather reference — the math the kernel must match, and
+    the engine's CPU fallback. Mirrors the training `_attention`
+    softmax exactly (f32 scores, -1e30 mask, softmax, cast, PV) so a
+    paged decode step reproduces the full re-forward loop's tokens."""
+    seq_k = k_pool[block_tables]           # [B, MAXB, BS, H, D]
+    seq_v = v_pool[block_tables]
+    b, maxb, bs, h, d = seq_k.shape
+    seq_k = seq_k.reshape(b, maxb * bs, h, d)
+    seq_v = seq_v.reshape(b, maxb * bs, h, d)
+    s = jnp.einsum("bhd,bshd->bhs", q, seq_k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    mask = jnp.arange(maxb * bs)[None, :] < context_lens[:, None]
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bshd->bhd", p, seq_v)

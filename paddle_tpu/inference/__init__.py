@@ -15,7 +15,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType"]
+           "PrecisionType", "PlaceType", "serving", "LLMEngine",
+           "SamplingParams"]
 
 
 class PrecisionType:
@@ -246,3 +247,19 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# the serving engine (LLMEngine / paged KV cache / continuous
+# batching) pulls in jax + the model stack — keep it LAZY so the
+# classic predictor surface stays import-light (PEP 562)
+def __getattr__(name):
+    if name == "serving":
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
+    if name in ("LLMEngine", "SamplingParams"):
+        from . import serving
+
+        return getattr(serving, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

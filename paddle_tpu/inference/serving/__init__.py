@@ -1,0 +1,31 @@
+"""paddle_tpu.inference.serving — the TPU-native serving engine.
+
+Reference capability: paddle/fluid/inference (the 61k-LoC deployment
+stack). Here the generation path is rebuilt around the TPU serving
+designs in PAPERS.md — Ragged Paged Attention (arxiv 2604.15464) and
+the Gemma-on-Cloud-TPU serving comparison (arxiv 2605.25645):
+
+  * `kv_cache`      block-allocated paged KV cache: fixed-size blocks
+                    in preallocated device pools, per-request block
+                    tables, alloc/free/defrag + admission control
+  * `scheduler`     continuous batching: FIFO admit / youngest-first
+                    evict / preempt between fused decode dispatches
+  * `model_runner`  the compiled prefill + paged decode programs
+                    (gpt2), per-request in-program sampling
+  * `engine`        `LLMEngine.generate()` / `add_request()`
+                    streaming front end, donated decode step through
+                    the persistent compile cache
+
+The ragged paged-attention decode kernel itself lives with its PR-8
+siblings in `incubate.nn.pallas.paged_attention`.
+"""
+from __future__ import annotations
+
+from .engine import LLMEngine
+from .kv_cache import (BlockAllocator, NULL_BLOCK, PagedKVCache,
+                       env_block_size, env_max_batch, env_pool_bytes)
+from .scheduler import Request, SamplingParams, Scheduler
+
+__all__ = ["LLMEngine", "SamplingParams", "Request", "Scheduler",
+           "PagedKVCache", "BlockAllocator", "NULL_BLOCK",
+           "env_block_size", "env_max_batch", "env_pool_bytes"]

@@ -1,0 +1,400 @@
+"""LLMEngine — the TPU-native generation front end.
+
+The user surface of the serving subsystem (ROADMAP item 1): a
+GPTForCausalLM plus a paged KV cache, a continuous-batching
+scheduler, and two compiled programs — per-bucket prefill and ONE
+fixed-shape decode step covering all `max_batch` slots — that
+together serve many concurrent mixed-length requests:
+
+    engine = LLMEngine(model)
+    engine.add_request([1, 2, 3], SamplingParams(max_new_tokens=8),
+                       on_token=stream_cb)          # streaming
+    outs = engine.generate([[1, 2, 3], [7, 8]])     # run-to-drain
+
+Per engine `step()`: admit+prefill whatever the scheduler lets in,
+grow block tables across block boundaries (evicting under pool
+pressure), then ONE decode dispatch for the whole batch — inactive
+slots ride along pointed at the NULL block. Stop conditions
+(eos/stop ids/max_new_tokens/max_seq_len) apply host-side on the
+returned tokens; finished requests free their blocks before the next
+admission pass.
+
+Compiled-step contract: the decode step is `jax.jit` with BOTH pools
+DONATED (the engine re-adopts the returned pools each dispatch — the
+PR-8/PR-9 donation discipline), and its first dispatch routes
+through the persistent compile cache (`jit.persistent_cache`) under
+the label `serve_decode:<Model>` — a serving replica restarting
+against a warm PADDLE_SERVE-sized pool skips the backend compile
+entirely (the ROADMAP cold-start story). Prefill compiles once per
+block-rounded prompt-length bucket, so prompt-length cardinality is
+`max_seq_len / block_size`, not `max_seq_len`.
+
+Failure path: a RESOURCE_EXHAUSTED dispatch (real, or injected at
+the `serve_decode` chaos site) evicts the youngest request and
+retries — serving degrades to a smaller batch instead of dying.
+
+Telemetry: `serve/{requests,tokens,prefill_us,decode_us,evictions,
+queue_depth,kv_blocks/*}` counters plus `serve_prefill`/
+`serve_decode` flight spans, all through the PR-1/PR-3 monitor hub.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+
+import numpy as np
+
+from ...core import monitor as _cmon
+from ...monitor import chaos as _chaos
+from ...monitor import flight as _flight
+from . import model_runner as _mr
+from .kv_cache import NULL_BLOCK, PagedKVCache, env_max_batch
+from .scheduler import (FINISHED, Request, SamplingParams,
+                        Scheduler)
+
+__all__ = ["LLMEngine"]
+
+
+class LLMEngine:
+    """Continuous-batching generation engine over one causal LM."""
+
+    def __init__(self, model, max_batch=None, block_size=None,
+                 num_blocks=None, pool_bytes=None, dtype=None,
+                 static_batching=False, use_kernel=None,
+                 donate=True):
+        import jax
+
+        self.params, self.config = _mr.extract_params(model)
+        cfg = self.config
+        self.max_batch = int(max_batch or env_max_batch())
+        self.max_seq_len = int(cfg.max_seq_len)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_heads, head_dim,
+            block_size=block_size, num_blocks=num_blocks,
+            pool_bytes=pool_bytes, dtype=dtype)
+        self.block_size = self.cache.block_size
+        # fixed table width: enough slots for a max-length sequence
+        self.max_blocks_per_seq = math.ceil(
+            self.max_seq_len / self.block_size)
+        self.scheduler = Scheduler(self.cache, self.max_batch,
+                                   self.max_seq_len,
+                                   static_batching=static_batching)
+        self._requests = {}          # req_id -> Request (all states)
+        if use_kernel is None:
+            from ...incubate.nn import pallas as _pl
+
+            use_kernel = _pl.kernels_available() and \
+                _pl.paged_attention.paged_decode_supported(
+                    head_dim, self.block_size)
+            self._kernel_interpret = _pl.interpret_mode()
+        else:
+            self._kernel_interpret = False
+        self.use_kernel = bool(use_kernel)
+        self._donate = bool(donate)
+
+        decode = functools.partial(
+            _mr.decode_step, n_head=cfg.num_heads,
+            eps=cfg.layer_norm_eps, block_size=self.block_size,
+            use_kernel=self.use_kernel,
+            interpret=self._kernel_interpret)
+        self._decode_jit = jax.jit(
+            decode, donate_argnums=(3, 4) if self._donate else ())
+        self._decode_exe = None      # persistent-cache hit, if any
+        self._prefill_jits = {}      # padded len -> jitted prefill
+        self._pcache_label = (
+            f"serve_decode:{type(model).__name__}")
+        self._oom_streak = 0         # consecutive OOM'd dispatches
+        # finished requests kept for result retrieval — bounded so a
+        # long-lived replica's host memory doesn't grow with total
+        # traffic (generate() releases its own as it returns)
+        self._keep_finished = 256
+
+    # -- request intake ----------------------------------------------
+    def add_request(self, prompt_ids, sampling=None, on_token=None,
+                    req_id=None):
+        """Queue one request; returns its id. `on_token(req, token)`
+        streams every generated token as its dispatch completes."""
+        req = Request(prompt_ids, sampling=sampling,
+                      on_token=on_token, req_id=req_id)
+        self.scheduler.add(req)
+        self._requests[req.req_id] = req
+        self._prune_finished()
+        _cmon.stat_add("serve/requests", 1)
+        return req.req_id
+
+    def _prune_finished(self):
+        """Cap retained FINISHED/ABORTED requests at
+        `_keep_finished` (oldest dropped first) — results live until
+        read or displaced, never forever."""
+        done = [rid for rid, r in self._requests.items()
+                if r.finished]
+        for rid in done[:max(0, len(done) - self._keep_finished)]:
+            # finished entries only: their blocks were released by
+            # scheduler.finish/abort before they ever became prunable
+            del self._requests[rid]  # noqa: PTA072
+
+    def release_request(self, req_id):
+        """Drop a finished request's retained record (results
+        consumed). Live requests must be aborted first."""
+        req = self._requests.get(req_id)
+        if req is not None and req.finished:
+            # finished-only guard above: blocks already released
+            del self._requests[req_id]  # noqa: PTA072
+
+    def abort_request(self, req_id):
+        req = self._requests.get(req_id)
+        if req is not None and not req.finished:
+            self.scheduler.abort(req)
+
+    def get_request(self, req_id):
+        return self._requests[req_id]
+
+    def has_unfinished(self):
+        return self.scheduler.has_work()
+
+    # -- the engine loop ---------------------------------------------
+    def step(self):
+        """One engine iteration: admissions (each prefilled, its
+        first token emitted) + one decode dispatch for the running
+        batch. Returns {req_id: token} emitted this step."""
+        emitted = {}
+
+        def _on_admit(req):
+            # prefill AS each request admits — a fault later in the
+            # same admission pass can't strand an admitted request
+            # with never-written K/V
+            self._emit(req, self._prefill(req), emitted)
+
+        admitted = self.scheduler.schedule(on_admit=_on_admit)
+        if not admitted and not self.scheduler.running \
+                and self.scheduler.waiting:
+            # an idle engine that can't admit its queue head will
+            # never make progress — a pool sized below one request's
+            # footprint must be LOUD, not a silent spin
+            head = self.scheduler.waiting[0]
+            need = self.cache.blocks_for_tokens(head.context_len) + 1
+            if need > self.cache.num_blocks - 1:
+                raise RuntimeError(
+                    f"KV pool too small: {head.req_id} needs {need} "
+                    f"block(s) but the pool has only "
+                    f"{self.cache.num_blocks - 1} usable — raise "
+                    "PADDLE_SERVE_POOL_BYTES or num_blocks")
+        if self.scheduler.running:
+            self._decode_batch(emitted)
+        return emitted
+
+    def generate(self, prompts, sampling=None):
+        """Submit `prompts` (lists of token ids) and run the engine
+        to drain; returns each prompt's generated ids, in order."""
+        ids = [self.add_request(p, sampling=sampling)
+               for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        outs = [self._requests[i].output_ids for i in ids]
+        for i in ids:                # results consumed: release
+            self.release_request(i)
+        return outs
+
+    # -- prefill -----------------------------------------------------
+    def _prefill_fn(self, padded_len):
+        import jax
+
+        jfn = self._prefill_jits.get(padded_len)
+        if jfn is None:
+            cfg = self.config
+            fn = functools.partial(
+                _mr.prefill_step, n_head=cfg.num_heads,
+                eps=cfg.layer_norm_eps, block_size=self.block_size)
+            jfn = jax.jit(
+                fn, donate_argnums=(3, 4) if self._donate else ())
+            self._prefill_jits[padded_len] = jfn
+        return jfn
+
+    def _prefill(self, req):
+        """Causal forward over the (re)admitted request's context —
+        prompt plus any generation an eviction preserved — writing
+        its K/V and sampling the next token."""
+        import jax.numpy as jnp
+
+        ctx = req.prompt_ids + req.output_ids
+        plen = len(ctx)
+        padded = self.cache.blocks_for_tokens(plen) * self.block_size
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :plen] = ctx
+        table = self.cache.block_table(req.req_id,
+                                       self.max_blocks_per_seq)
+        s = req.sampling
+        t0 = time.perf_counter()
+        with _flight.in_flight("serve_prefill", req.req_id,
+                               tokens=plen):
+            tok, self.cache.k, self.cache.v = self._prefill_fn(padded)(
+                self.params, jnp.asarray(ids), np.int32(plen),
+                self.cache.k, self.cache.v, jnp.asarray(table),
+                np.float32(s.temperature), np.int32(s.top_k),
+                np.uint32(_mr.seed_for(s.seed, plen)))
+            tok = int(tok)
+        _cmon.stat_add("serve/prefill_us",
+                       int((time.perf_counter() - t0) * 1e6))
+        return tok
+
+    # -- decode ------------------------------------------------------
+    def _batch_arrays(self):
+        """Fixed-shape [max_batch] dispatch inputs; inactive slots
+        decode garbage against the NULL block and are dropped on the
+        host side."""
+        b = self.max_batch
+        ids = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        tables = np.full((b, self.max_blocks_per_seq), NULL_BLOCK,
+                         np.int32)
+        lens = np.ones((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        topk = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
+        for slot, req in self.scheduler.running.items():
+            ctx = req.prompt_ids + req.output_ids
+            ids[slot] = ctx[-1]
+            pos[slot] = len(ctx) - 1
+            tables[slot] = self.cache.block_table(
+                req.req_id, self.max_blocks_per_seq)
+            lens[slot] = len(ctx)
+            s = req.sampling
+            temp[slot] = s.temperature
+            topk[slot] = s.top_k
+            seeds[slot] = _mr.seed_for(s.seed, len(ctx))
+        return ids, pos, tables, lens, temp, topk, seeds
+
+    def _dispatch_decode(self, arrays):
+        import jax.numpy as jnp
+
+        ids, pos, tables, lens, temp, topk, seeds = arrays
+        args = (self.params, jnp.asarray(ids), jnp.asarray(pos),
+                self.cache.k, self.cache.v, jnp.asarray(tables),
+                jnp.asarray(lens), jnp.asarray(temp),
+                jnp.asarray(topk), jnp.asarray(seeds))
+        if self._decode_exe is None:
+            self._load_persistent(args)
+        fn = self._decode_exe or self._decode_jit
+        try:
+            toks, self.cache.k, self.cache.v = fn(*args)
+        except TypeError:
+            if fn is not self._decode_jit:   # stale cached executable
+                self._decode_exe = self._decode_jit
+                toks, self.cache.k, self.cache.v = \
+                    self._decode_jit(*args)
+            else:
+                raise
+        return np.asarray(toks)
+
+    def _load_persistent(self, args):
+        """First decode dispatch: route the compile through the PR-8
+        persistent cache so a serving replica restart is a warm hit.
+        Best effort — any trouble keeps the plain jitted step."""
+        from ...jit import persistent_cache as _pcache
+
+        self._decode_exe = self._decode_jit
+        if not _pcache.enabled():
+            return
+        try:
+            lowered = self._decode_jit.lower(*args)
+            compiled, outcome = _pcache.load_or_compile(
+                lowered, self._pcache_label)
+            if outcome != "off":
+                self._decode_exe = compiled
+        except Exception:
+            self._decode_exe = self._decode_jit
+
+    def _pools_deleted(self):
+        """Did a failed DONATING dispatch consume the pools? (A real
+        RESOURCE_EXHAUSTED mid-execution deletes donated buffers —
+        retrying with them is the PTA041 use-after-donate crash.)"""
+        try:
+            return bool(self.cache.k.is_deleted()
+                        or self.cache.v.is_deleted())
+        except Exception:
+            return False
+
+    def _decode_batch(self, emitted):
+        """Grow tables, dispatch once, apply stop conditions. An OOM
+        (real or chaos-injected) evicts the youngest request and
+        retries with the smaller batch; if the failed dispatch
+        consumed the DONATED pools, rebuild them and replay every
+        running request (position-keyed sampling makes the replay
+        token-exact). A persistent OOM re-raises after
+        max(3, max_batch) consecutive failed dispatches instead of
+        spinning on evict/readmit forever."""
+        # snapshot the batch, but re-check membership per request:
+        # growing request A can evict request B later in the
+        # snapshot, and growing an evicted B would strand blocks on
+        # a request the dispatch no longer covers
+        for req in list(self.scheduler.running.values()):
+            self.scheduler.ensure_capacity(req)
+        if not self.scheduler.running:
+            return
+        arrays = self._batch_arrays()
+        t0 = time.perf_counter()
+        try:
+            with _flight.in_flight("serve_decode", "decode",
+                                   batch=len(self.scheduler.running)):
+                if _chaos._armed:
+                    _chaos.hit("serve_decode",
+                               batch=len(self.scheduler.running))
+                toks = self._dispatch_decode(arrays)
+        except Exception as e:
+            from ...monitor import memory as _memory
+
+            if not _memory.is_oom_error(e):
+                raise
+            self._oom_streak += 1
+            if self._oom_streak > max(3, self.max_batch):
+                raise
+            _cmon.stat_add("serve/oom_evictions", 1)
+            if self._pools_deleted():
+                _cmon.stat_add("serve/pool_resets", 1)
+                _flight.record("serve_pool_reset",
+                               batch=len(self.scheduler.running))
+                for req in list(self.scheduler.running.values()):
+                    self.scheduler.evict(req)
+                self.cache.reset_pools()
+                return                # next step() re-prefills
+            victim = self.scheduler._pick_victim()
+            if victim is None:
+                raise
+            self.scheduler.evict(victim)
+            return self._decode_batch(emitted)
+        self._oom_streak = 0
+        _cmon.stat_add("serve/decode_us",
+                       int((time.perf_counter() - t0) * 1e6))
+        for slot, req in list(self.scheduler.running.items()):
+            self._emit(req, int(toks[slot]), emitted)
+
+    # -- token emission / stop conditions ----------------------------
+    def _emit(self, req, token, emitted):
+        req.output_ids.append(token)
+        req.token_times.append(time.perf_counter())
+        emitted[req.req_id] = token
+        _cmon.stat_add("serve/tokens", 1)
+        if req.on_token is not None:
+            try:
+                req.on_token(req.req_id, token)
+            except Exception:
+                _cmon.stat_add("serve/callback_errors", 1)
+        s = req.sampling
+        done = (req.stop_hit(token)
+                or len(req.output_ids) >= s.max_new_tokens
+                or req.context_len >= self.max_seq_len)
+        if done:
+            self.scheduler.finish(req, state=FINISHED)
+
+    # -- accounting --------------------------------------------------
+    def check_drained(self):
+        """Zero-leak audit after a drain: no live requests may remain
+        and every KV block must be back on the free list. Returns the
+        leak map ({} when clean) — with PADDLE_SANITIZE=serving armed
+        each leak is also a PTA070 finding."""
+        live = [r.req_id for r in self._requests.values()
+                if not r.finished]
+        leaks = self.cache.allocator.audit_leaks(live)
+        return leaks

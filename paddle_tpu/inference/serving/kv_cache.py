@@ -1,0 +1,316 @@
+"""Block-allocated paged KV cache (the vLLM/Ragged-Paged-Attention
+memory model, PAPERS.md arxiv 2604.15464, on TPU-native pools).
+
+Generation workloads can't preallocate per-request [max_seq] KV
+tensors — at 8+ concurrent mixed-length requests that wastes most of
+HBM on padding. Instead the cache is a FIXED device pool of
+fixed-size blocks per layer:
+
+    k/v pools:  [num_layers, num_blocks, block_size, n_head, head_dim]
+
+and every request owns a host-side BLOCK TABLE — the ordered list of
+pool block ids covering its tokens. Token `t` of a request lives at
+`(table[t // block_size], t % block_size)`. Attention reads K/V
+through the table (dense gather fallback, or the Pallas ragged
+paged-attention kernel in `incubate.nn.pallas.paged_attention`), so
+sequences of wildly different lengths share one pool with ZERO
+padding waste beyond the last partial block.
+
+Block 0 is the reserved NULL block: padded prompt positions and
+inactive batch slots write their garbage K/V there, so the compiled
+programs never need a "don't write" branch — reads never see it
+because every read is masked by the request's context length.
+
+The allocator is the admission-control truth: `can_admit()` answers
+whether a prompt fits, `alloc()`/`release()` move blocks between the
+free list and per-owner tables, and the `serve/kv_blocks/{used,free}`
+gauges (PR-1 monitor hub) track occupancy. Pool sizing comes from
+`PADDLE_SERVE_POOL_BYTES` or — on devices with PJRT stats — from the
+PR-5 `monitor.memory.memory_stats()` free-HBM reading, discounted by
+the per-program footprints already resident.
+
+PTA07x (block-leak) accounting: with `PADDLE_SANITIZE=serving` armed,
+double-free / free-of-unowned trips a PTA071 finding at the faulting
+call, and `audit_leaks(live_owners)` reports PTA070 for blocks still
+owned by requests the serving layer no longer tracks. The static half
+lives in `paddle_tpu.analysis.serving`.
+"""
+from __future__ import annotations
+
+import math
+import os
+from collections import deque
+
+import numpy as np
+
+from ...core import monitor as _cmon
+from ...monitor import sanitize as _san
+
+__all__ = ["BlockAllocator", "PagedKVCache", "NULL_BLOCK",
+           "env_block_size", "env_pool_bytes", "env_max_batch",
+           "auto_num_blocks", "bytes_per_block"]
+
+NULL_BLOCK = 0  # reserved garbage-dump block, never owned
+
+_DEF_BLOCK_SIZE = 16
+_DEF_MAX_BATCH = 8
+# CPU / no-stats fallback pool budget — big enough for the tests'
+# tiny models, small enough to exercise eviction in the chaos flood
+_DEF_POOL_BYTES = 64 << 20
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_block_size():
+    """PADDLE_SERVE_BLOCK_SIZE — tokens per KV block (default 16)."""
+    return max(1, _env_int("PADDLE_SERVE_BLOCK_SIZE", _DEF_BLOCK_SIZE))
+
+
+def env_pool_bytes():
+    """PADDLE_SERVE_POOL_BYTES — total KV pool budget in bytes
+    (default 0 = auto-size from device memory stats)."""
+    return _env_int("PADDLE_SERVE_POOL_BYTES", 0)
+
+
+def env_max_batch():
+    """PADDLE_SERVE_MAX_BATCH — decode batch width (default 8)."""
+    return max(1, _env_int("PADDLE_SERVE_MAX_BATCH", _DEF_MAX_BATCH))
+
+
+def bytes_per_block(num_layers, block_size, n_head, head_dim, dtype):
+    """HBM cost of ONE block id across all layers, K and V."""
+    itemsize = np.dtype(dtype).itemsize
+    return 2 * num_layers * block_size * n_head * head_dim * itemsize
+
+
+def auto_num_blocks(per_block, pool_bytes=None, fraction=0.45):
+    """Pool size in blocks: the explicit budget when given (env or
+    argument), else `fraction` of the device's free HBM per the PR-5
+    memory stats (bytes_limit - bytes_in_use already accounts for the
+    resident compiled programs + params), else the CPU fallback."""
+    budget = pool_bytes if pool_bytes else env_pool_bytes()
+    if not budget:
+        try:
+            from ...monitor import memory as _memory
+
+            stats = _memory.memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0) or 0)
+            used = int(stats.get("bytes_in_use", 0) or 0)
+            if limit > used > 0:
+                budget = int((limit - used) * fraction)
+        except Exception:
+            budget = 0
+    if not budget:
+        budget = _DEF_POOL_BYTES
+    # +1: block 0 is the null block, not usable capacity
+    return max(2, budget // max(1, per_block) + 1)
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's block ids.
+
+    Block 0 (NULL_BLOCK) is never handed out. Ownership is tracked
+    per request id so leaks are attributable: `release(owner)` frees
+    everything an owner holds, `audit_leaks(live)` reports blocks
+    owned by ids the caller no longer tracks (PTA070)."""
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 null + 1 usable), got "
+                f"{num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free = deque(range(1, self.num_blocks))
+        self._owned = {}  # owner id -> [block ids]
+        self._sync_gauges()
+
+    # -- occupancy ---------------------------------------------------
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return self.num_blocks - 1 - len(self._free)
+
+    def owners(self):
+        return sorted(self._owned)
+
+    def owned(self, owner):
+        return list(self._owned.get(owner, ()))
+
+    def can_alloc(self, n):
+        return len(self._free) >= n
+
+    def _sync_gauges(self):
+        _cmon.stat_set("serve/kv_blocks/used", self.used_blocks)
+        _cmon.stat_set("serve/kv_blocks/free", self.free_blocks)
+
+    # -- alloc/free --------------------------------------------------
+    def alloc(self, owner, n=1):
+        """Give `owner` `n` more blocks; returns the new block ids, or
+        None when the pool can't satisfy the request (the caller's cue
+        to evict — never a partial grant)."""
+        if n <= 0:
+            return []
+        if len(self._free) < n:
+            return None
+        got = [self._free.popleft() for _ in range(n)]
+        self._owned.setdefault(owner, []).extend(got)
+        self._sync_gauges()
+        return got
+
+    def release(self, owner):
+        """Free every block `owner` holds; returns how many. Unknown
+        owners are a no-op (a request evicted before its first alloc
+        has nothing to free)."""
+        blocks = self._owned.pop(owner, None)
+        if not blocks:
+            return 0
+        self._free.extend(blocks)
+        self._sync_gauges()
+        return len(blocks)
+
+    def free_one(self, owner, block_id):
+        """Return one specific block (shrink paths). Freeing a block
+        the owner doesn't hold is the double-free bug class — PTA071
+        when the serving sanitizer is armed, ValueError always."""
+        blocks = self._owned.get(owner)
+        if not blocks or block_id not in blocks:
+            if getattr(_san, "_serving", False):
+                _san._emit(
+                    "PTA071",
+                    f"free of block {block_id} not owned by "
+                    f"{owner!r} (double-free or foreign free)",
+                    dedup=("PTA071", owner, block_id))
+            raise ValueError(
+                f"block {block_id} is not owned by {owner!r}")
+        blocks.remove(block_id)
+        if not blocks:
+            self._owned.pop(owner, None)
+        self._free.append(block_id)
+        self._sync_gauges()
+        return block_id
+
+    # -- leak audit (PTA070 runtime half) ----------------------------
+    def audit_leaks(self, live_owners=()):
+        """Blocks owned by request ids the serving layer no longer
+        tracks are leaked — every completed/evicted/aborted request
+        must have released. Returns {owner: [blocks]} of leaks; with
+        the `serving` sanitize family armed each leak also emits a
+        PTA070 finding through the PR-9 machinery."""
+        live = set(live_owners)
+        leaked = {o: list(b) for o, b in self._owned.items()
+                  if o not in live and b}
+        if leaked and getattr(_san, "_serving", False):
+            for owner, blocks in sorted(leaked.items(),
+                                        key=lambda kv: str(kv[0])):
+                _san._emit(
+                    "PTA070",
+                    f"KV block leak: {len(blocks)} block(s) still "
+                    f"owned by finished/unknown request {owner!r}",
+                    dedup=("PTA070", owner))
+        return leaked
+
+
+class PagedKVCache:
+    """The device pools + the allocator + per-request block tables."""
+
+    def __init__(self, num_layers, num_heads, head_dim,
+                 block_size=None, num_blocks=None, pool_bytes=None,
+                 dtype=None):
+        import jax.numpy as jnp
+
+        self.block_size = int(block_size or env_block_size())
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype or jnp.float32)
+        per_block = bytes_per_block(num_layers, self.block_size,
+                                    num_heads, head_dim, self.dtype)
+        if num_blocks is None:
+            num_blocks = auto_num_blocks(per_block,
+                                         pool_bytes=pool_bytes)
+        self.num_blocks = int(num_blocks)
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.allocator = BlockAllocator(self.num_blocks)
+
+    # -- geometry ----------------------------------------------------
+    def blocks_for_tokens(self, n_tokens):
+        return max(1, math.ceil(n_tokens / self.block_size))
+
+    def can_admit(self, n_tokens, lookahead_blocks=1):
+        """Admission control: room for the prompt's blocks plus a
+        decode lookahead so a request admitted now can generate at
+        least one block of tokens before pool pressure."""
+        need = self.blocks_for_tokens(n_tokens) + lookahead_blocks
+        return self.allocator.can_alloc(need)
+
+    def block_table(self, owner, max_blocks):
+        """Padded int32 device-table row for one request: its owned
+        blocks in token order, NULL_BLOCK beyond."""
+        blocks = self.allocator.owned(owner)
+        if len(blocks) > max_blocks:
+            raise ValueError(
+                f"request {owner!r} holds {len(blocks)} blocks > "
+                f"max_blocks_per_seq={max_blocks}")
+        row = np.full((max_blocks,), NULL_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    def reset_pools(self):
+        """Fresh zero pools — recovery after a failed DONATING
+        dispatch consumed the old ones (a real RESOURCE_EXHAUSTED
+        mid-execution deletes donated buffers). The caller must
+        re-prefill every sequence: allocator state survives but the
+        K/V contents are gone."""
+        import jax.numpy as jnp
+
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+
+    # -- defrag ------------------------------------------------------
+    def defrag(self):
+        """Compact allocated blocks to the front of the pool (one
+        device gather per pool) so a long-lived server's free list
+        stays contiguous — contiguous tables DMA better through the
+        paged kernel's block streaming. Returns the number of blocks
+        that moved; owner tables are rewritten in place."""
+        owners = self.allocator.owners()
+        mapping = {NULL_BLOCK: NULL_BLOCK}
+        nxt = 1
+        for owner in owners:
+            for b in self.allocator._owned[owner]:
+                mapping[b] = nxt
+                nxt += 1
+        moved = sum(1 for old, new in mapping.items() if old != new)
+        if not moved:
+            return 0
+        # perm[new] = old; untouched tail keeps identity so freed
+        # block contents (never read — reads are context-masked) need
+        # no care beyond staying in range
+        perm = np.arange(self.num_blocks)
+        for old, new in mapping.items():
+            perm[new] = old
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(perm)
+        self.k = self.k[:, idx]
+        self.v = self.v[:, idx]
+        for owner in owners:
+            self.allocator._owned[owner] = [
+                mapping[b] for b in self.allocator._owned[owner]]
+        self.allocator._free = deque(
+            range(nxt, self.num_blocks))
+        self.allocator._sync_gauges()
+        return moved

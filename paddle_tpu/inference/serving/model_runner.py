@@ -1,0 +1,205 @@
+"""GPT-2 paged-serving forward passes (prefill + single-token decode).
+
+The serving engine never calls `GPTModel.forward` — re-running the
+full prompt for every generated token is O(S^2) per request. Instead
+this module owns the two compiled programs of the generation path:
+
+  * `prefill_step` — ONE causal forward over the (block-padded)
+    prompt that also scatters every position's K/V into the paged
+    pools through the request's block table, and samples the first
+    generated token from the last REAL prompt row.
+  * `decode_step`  — one token per running sequence: embed, scan the
+    layer stack reading/writing K/V through the pools, ragged paged
+    attention over each request's cached context, sample.
+
+Both reuse the training model's own math helpers (`_layer_norm`,
+`_residual_layer_norm`, `_attention` from `text.models.gpt`) so the
+serving path computes EXACTLY what the training forward computes —
+the e2e contract is greedy tokens identical to a sequential
+full-re-forward loop, and every numerical divergence between the two
+paths is a bug, not noise.
+
+Sampling is in-program and per-request: `temperature == 0` is exact
+argmax (greedy), `temperature > 0` draws from the (optionally
+top-k-filtered) softmax with a seed the HOST derives from (request
+seed, absolute token index) — so an evicted-and-re-prefilled request
+replays the same random choices it would have made uninterrupted,
+whatever batch it lands in.
+
+Functions take the raw jnp parameter tree (`extract_params`), not
+Layers: the engine jits them with donated pools, and the PR-8
+persistent compile cache keys their StableHLO like any other program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...text.models.gpt import (_attention, _layer_norm,
+                                _residual_layer_norm)
+
+__all__ = ["extract_params", "prefill_step", "decode_step",
+           "sample_tokens", "seed_for"]
+
+
+def extract_params(model):
+    """(jnp param tree, GPTConfig) from GPTForCausalLM / GPTModel."""
+    gpt = getattr(model, "gpt", model)
+    tree = gpt._params_tree()
+    params = jax.tree_util.tree_map(
+        lambda p: p._value if hasattr(p, "_value") else jnp.asarray(p),
+        tree)
+    return params, gpt.config
+
+
+def seed_for(request_seed, token_index):
+    """Host-side per-token sampling seed: a pure function of the
+    request's seed and the ABSOLUTE position being sampled, so
+    replayed decodes (eviction -> re-prefill) and different batch
+    compositions draw identical randomness."""
+    return (int(request_seed) * 1000003 + int(token_index)) \
+        & 0x7FFFFFFF
+
+
+def sample_tokens(logits, temperature, top_k, seeds):
+    """Per-request next-token selection over [B, V] logits.
+
+    temperature[b] == 0 -> exact argmax (greedy decode);
+    temperature[b] > 0  -> categorical over logits/temperature with
+    ranks >= top_k[b] masked out when top_k[b] > 0. The rank trick
+    (double argsort) keeps k per-request and traced — `lax.top_k`
+    would force one compiled program per distinct k."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def draw(lg, t, k, seed):
+        ranks = jnp.argsort(jnp.argsort(-lg))
+        keep = ranks < jnp.where(k > 0, k, vocab)
+        lg = jnp.where(keep, lg, -jnp.inf)
+        key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+        return jax.random.categorical(
+            key, lg / jnp.maximum(t, 1e-6)).astype(jnp.int32)
+
+    sampled = jax.vmap(draw)(logits, temperature, top_k, seeds)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def _scatter_positions(block_table, positions, block_size):
+    """(pool block ids, in-block offsets) for a vector of token
+    positions resolved through ONE request's block table."""
+    return (jnp.take(block_table, positions // block_size, axis=0),
+            positions % block_size)
+
+
+def prefill_step(params, ids, prompt_len, k_pool, v_pool, block_table,
+                 temperature, top_k, seed, *, n_head, eps, block_size):
+    """Causal forward over one block-padded prompt.
+
+    ids [1, P] (P a multiple of block_size), prompt_len traced scalar.
+    Writes all P positions' K/V through `block_table` [MAXB] — padded
+    tail positions resolve to slots the decode steps overwrite before
+    any masked read could see them, or to the NULL block. Returns
+    (first sampled token [], k_pool, v_pool)."""
+    p_len = ids.shape[1]
+    x = jnp.take(params["wte"], ids, axis=0)
+    x = x + jnp.take(params["wpe"], jnp.arange(p_len), axis=0)
+
+    b, s = ids.shape
+    d = params["wte"].shape[1] // n_head
+
+    def body(carry, bp):
+        h = _layer_norm(carry, bp["ln1_w"], bp["ln1_b"], eps)
+        qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # dense causal attention over the prompt itself — the
+        # training math, bit-for-bit (no pool read needed: the
+        # prompt IS the whole context)
+        attn = _attention(q, k, v, n_head, use_flash=False)
+        attn = attn @ bp["proj_w"] + bp["proj_b"]
+        h2, x2 = _residual_layer_norm(attn, carry, bp["ln2_w"],
+                                      bp["ln2_b"], eps)
+        ffn = h2 @ bp["fc1_w"] + bp["fc1_b"]
+        ffn = jax.nn.gelu(ffn)
+        ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]
+        out = x2 + ffn
+        return out, (k.reshape(b, s, n_head, d),
+                     v.reshape(b, s, n_head, d))
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    # ks/vs [L, 1, P, H, D] -> scatter every position through the
+    # table in one batched update per pool
+    positions = jnp.arange(p_len)
+    blk, off = _scatter_positions(block_table, positions, block_size)
+    k_pool = k_pool.at[:, blk, off].set(
+        ks[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[:, blk, off].set(
+        vs[:, 0].astype(v_pool.dtype))
+
+    x = _layer_norm(x, params["lnf_w"], params["lnf_b"], eps)
+    last = jax.lax.dynamic_index_in_dim(x[0], prompt_len - 1, axis=0,
+                                        keepdims=False)
+    logits = last @ params["wte"].T                    # [V]
+    token = sample_tokens(logits[None], temperature[None],
+                          top_k[None], seed[None])[0]
+    return token, k_pool, v_pool
+
+
+def decode_step(params, ids, positions, k_pool, v_pool, block_tables,
+                context_lens, temperature, top_k, seeds, *, n_head,
+                eps, block_size, use_kernel=False, interpret=False):
+    """One generation step for the whole running batch.
+
+    ids/positions [B]; context_lens[b] == positions[b] + 1 (this
+    token included). Each layer writes this token's K/V at
+    (tables[b, pos // BS], pos % BS) BEFORE attending — so the
+    current token sees itself, and garbage a block-padded prefill
+    left in that slot is overwritten before any read. Returns
+    (next tokens [B], k_pool, v_pool)."""
+    from ...incubate.nn.pallas import paged_attention as _pa
+
+    bsz = ids.shape[0]
+    hidden = params["wte"].shape[1]
+    d = hidden // n_head
+    scale = 1.0 / math.sqrt(d)
+    x = jnp.take(params["wte"], ids, axis=0)
+    x = x + jnp.take(params["wpe"], positions, axis=0)
+
+    blk = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    off = positions % block_size
+
+    def body(carry, xs):
+        bp, kc, vc = xs
+        h = _layer_norm(carry, bp["ln1_w"], bp["ln1_b"], eps)
+        qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(bsz, n_head, d)
+        kc = kc.at[blk, off].set(
+            k.reshape(bsz, n_head, d).astype(kc.dtype))
+        vc = vc.at[blk, off].set(
+            v.reshape(bsz, n_head, d).astype(vc.dtype))
+        if use_kernel:
+            attn = _pa.paged_attention(q, kc, vc, block_tables,
+                                       context_lens, sm_scale=scale,
+                                       interpret=interpret)
+        else:
+            attn = _pa.paged_attention_reference(
+                q, kc, vc, block_tables, context_lens,
+                sm_scale=scale)
+        attn = attn.reshape(bsz, hidden)
+        attn = attn @ bp["proj_w"] + bp["proj_b"]
+        h2, x2 = _residual_layer_norm(attn, carry, bp["ln2_w"],
+                                      bp["ln2_b"], eps)
+        ffn = h2 @ bp["fc1_w"] + bp["fc1_b"]
+        ffn = jax.nn.gelu(ffn)
+        ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]
+        return x2 + ffn, (kc, vc)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], k_pool, v_pool))
+    x = _layer_norm(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = x @ params["wte"].T                       # [B, V]
+    tokens = sample_tokens(logits, temperature, top_k, seeds)
+    return tokens, k_pool, v_pool

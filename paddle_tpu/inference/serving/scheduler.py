@@ -1,0 +1,260 @@
+"""Continuous-batching scheduler (admit / evict / preempt between
+fused decode dispatches).
+
+The serving-architecture comparison (PAPERS.md arxiv 2605.25645) is
+blunt about what makes TPU serving throughput: the decode program is
+ONE fixed-shape compiled dispatch, and the scheduler's whole job is
+keeping its batch slots full — requests join and leave BETWEEN
+dispatches, never inside one. This module is that control loop's
+policy half (the engine owns the dispatches):
+
+  * FIFO admission: `add()` queues, `schedule()` admits while a batch
+    slot AND the KV pool's admission check (`can_admit`: prompt
+    blocks + one decode-lookahead block) both say yes. Admission is a
+    chaos site (`serve_admit`) — slow clients and admission-time
+    faults inject there.
+  * Block growth: a running request crossing a block boundary asks
+    `ensure_capacity()` for its next block before the dispatch that
+    writes into it.
+  * Preemption: when the pool can't grow a running request (or the
+    dispatch OOMs — the engine routes RESOURCE_EXHAUSTED here), the
+    YOUNGEST running request is evicted: its blocks free immediately,
+    its prompt + generated-so-far re-queues at the FRONT, and a later
+    admission re-prefills it — generated tokens are kept, so the
+    replayed decode continues exactly where it stopped (the vLLM
+    recompute policy; sampling seeds are position-keyed so replay is
+    deterministic).
+  * `static_batching=True` degrades admission to the classic
+    serve-a-batch-drain-a-batch policy — the bench twin that measures
+    what continuous batching buys.
+
+Every state change feeds the PR-1 monitor hub: `serve/requests`,
+`serve/evictions`, `serve/queue_depth` (gauge), and the engine adds
+tokens/latency counters around the dispatches.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+
+from ...core import monitor as _cmon
+from ...monitor import chaos as _chaos
+from ...monitor import flight as _flight
+
+__all__ = ["SamplingParams", "Request", "Scheduler",
+           "WAITING", "RUNNING", "FINISHED", "ABORTED"]
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+ABORTED = "aborted"
+
+
+class SamplingParams:
+    """Per-request generation controls (the vLLM surface, trimmed to
+    what the compiled sampler implements)."""
+
+    def __init__(self, max_new_tokens=16, temperature=0.0, top_k=0,
+                 eos_token_id=None, stop_token_ids=(), seed=0):
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_token_id = eos_token_id
+        self.stop_token_ids = tuple(stop_token_ids)
+        self.seed = int(seed)
+
+    def __repr__(self):
+        return (f"SamplingParams(max_new_tokens="
+                f"{self.max_new_tokens}, temperature="
+                f"{self.temperature}, top_k={self.top_k})")
+
+
+class Request:
+    """One generation request moving through the engine."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, sampling=None, on_token=None,
+                 req_id=None):
+        self.req_id = (f"req-{next(Request._ids)}"
+                       if req_id is None else str(req_id))
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.sampling = sampling or SamplingParams()
+        self.on_token = on_token
+        self.state = WAITING
+        self.output_ids = []
+        self.slot = None           # decode batch slot while RUNNING
+        self.evictions = 0
+        self.token_times = []      # perf_counter per emitted token
+
+    @property
+    def context_len(self):
+        """Tokens whose K/V must be live for the next decode."""
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def finished(self):
+        return self.state in (FINISHED, ABORTED)
+
+    def stop_hit(self, token):
+        s = self.sampling
+        return (token == s.eos_token_id
+                or token in s.stop_token_ids)
+
+    def __repr__(self):
+        return (f"<Request {self.req_id} {self.state} "
+                f"prompt={len(self.prompt_ids)} "
+                f"out={len(self.output_ids)}>")
+
+
+class Scheduler:
+    """Admission/eviction policy over one PagedKVCache + a fixed
+    decode batch width."""
+
+    def __init__(self, cache, max_batch, max_seq_len,
+                 static_batching=False):
+        self.cache = cache
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.static_batching = bool(static_batching)
+        self.waiting = deque()
+        self.running = {}          # slot -> Request
+        self._admit_seq = itertools.count()
+        self._admitted_at = {}     # req_id -> admission ordinal
+
+    # -- queue -------------------------------------------------------
+    def add(self, request):
+        if request.context_len >= self.max_seq_len:
+            raise ValueError(
+                f"{request.req_id}: prompt ({request.context_len}) "
+                f"leaves no room under max_seq_len="
+                f"{self.max_seq_len}")
+        request.state = WAITING
+        self.waiting.append(request)
+        self._sync_depth()
+        return request
+
+    def _requeue_front(self, request):
+        request.state = WAITING
+        request.slot = None
+        self.waiting.appendleft(request)
+        self._sync_depth()
+
+    def _sync_depth(self):
+        _cmon.stat_set("serve/queue_depth", len(self.waiting))
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    # -- admission ---------------------------------------------------
+    def _free_slots(self):
+        return [s for s in range(self.max_batch)
+                if s not in self.running]
+
+    def schedule(self, on_admit=None):
+        """Admit as many waiting requests as slots + pool allow.
+        `on_admit(req)` runs IMMEDIATELY after each admission (the
+        engine prefills there) so a fault later in the same pass —
+        an admission-site chaos raise for request N+1 — can never
+        strand request N admitted-but-never-prefilled; the chaos hit
+        itself fires BEFORE the request takes any pool resources.
+        Static-batching mode only admits into an EMPTY batch."""
+        admitted = []
+        if self.static_batching and self.running:
+            return admitted
+        slots = self._free_slots()
+        while slots and self.waiting:
+            req = self.waiting[0]
+            need_tokens = req.context_len
+            if not self.cache.can_admit(need_tokens):
+                break
+            if _chaos._armed:
+                # slow-client / admission faults land here, BEFORE
+                # the request takes any pool resources
+                _chaos.hit("serve_admit", req=req.req_id)
+            self.waiting.popleft()
+            nblocks = self.cache.blocks_for_tokens(need_tokens)
+            got = self.cache.allocator.alloc(req.req_id, nblocks)
+            if got is None:        # raced the lookahead margin
+                self._requeue_front(req)
+                break
+            req.state = RUNNING
+            req.slot = slots.pop(0)
+            self.running[req.slot] = req
+            self._admitted_at[req.req_id] = next(self._admit_seq)
+            admitted.append(req)
+            _flight.record("serve_admit", req=req.req_id,
+                           slot=req.slot, blocks=nblocks)
+            if on_admit is not None:
+                on_admit(req)
+        self._sync_depth()
+        return admitted
+
+    # -- block growth / preemption -----------------------------------
+    def ensure_capacity(self, request):
+        """Grow the request's table to cover its next token; evicts
+        other requests under pool pressure. False when the request
+        itself had to be evicted (pool too small even after evicting
+        everyone younger) — or was ALREADY evicted by an earlier
+        grow in the same pass (growing a non-running request would
+        allocate blocks no dispatch ever uses: the PTA070 leak the
+        serving sanitizer hunts)."""
+        if self.running.get(request.slot) is not request:
+            return False
+        need = self.cache.blocks_for_tokens(request.context_len + 1)
+        while len(self.cache.allocator.owned(request.req_id)) < need:
+            got = self.cache.allocator.alloc(request.req_id, 1)
+            if got is not None:
+                continue
+            victim = self._pick_victim(exclude=request)
+            if victim is None:
+                self.evict(request)
+                return False
+            self.evict(victim)
+        return True
+
+    def _pick_victim(self, exclude=None):
+        """Youngest-admitted running request (vLLM policy: the newest
+        request loses the least recompute work)."""
+        cands = [r for r in self.running.values() if r is not exclude]
+        if not cands:
+            return None
+        return max(cands,
+                   key=lambda r: self._admitted_at.get(r.req_id, -1))
+
+    def evict(self, request):
+        """Preempt a running request: free its blocks NOW, requeue it
+        at the front with its generated tokens kept (re-prefill will
+        rebuild the KV it lost)."""
+        self.running.pop(request.slot, None)
+        self.cache.allocator.release(request.req_id)
+        self._admitted_at.pop(request.req_id, None)
+        request.evictions += 1
+        self._requeue_front(request)
+        _cmon.stat_add("serve/evictions", 1)
+        _flight.record("serve_evict", req=request.req_id,
+                       evictions=request.evictions)
+
+    # -- completion --------------------------------------------------
+    def finish(self, request, state=FINISHED):
+        request.state = state
+        if request.slot is not None:
+            self.running.pop(request.slot, None)
+            request.slot = None
+        self.cache.allocator.release(request.req_id)
+        self._admitted_at.pop(request.req_id, None)
+        _flight.record("serve_finish", req=request.req_id,
+                       tokens=len(request.output_ids), state=state)
+
+    def abort(self, request):
+        """Cancel wherever it is; blocks release immediately."""
+        if request in self.waiting:
+            self.waiting.remove(request)
+            self._sync_depth()
+        self.finish(request, state=ABORTED)

@@ -14,9 +14,12 @@ tooling around them):
     time), `io/...` (dataloader batches/bytes/ring waits, plus the
     device-feed stage's `io/h2d_us` and
     `io/device_prefetch/{depth,stalls,bytes}`), `step/...` (train-loop
-    metrics via StepTimer), and `analysis/...` (paddle_tpu.analysis:
+    metrics via StepTimer), `analysis/...` (paddle_tpu.analysis:
     checks run, `analysis/<PTA code>/findings` per diagnostic,
-    hook_errors).
+    hook_errors), and `serve/...` (the inference.serving engine:
+    requests/tokens/prefill_us/decode_us/evictions, the
+    `serve/kv_blocks/{used,free}` pool gauges and the
+    `serve/queue_depth` admission gauge).
 
   * StepTimer — per-step training metrics hub: step time, throughput,
     loss, lr and PJRT device-memory high water, written into the

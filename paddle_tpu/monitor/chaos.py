@@ -18,6 +18,8 @@ Sites (see SITES; `python -m paddle_tpu.monitor chaos` lists them):
     cache_write  persistent compile-cache entry write (jit.persistent_cache)
     io_fetch     DataLoader sample fetch (mp worker loop + in-process)
     dispatch     compiled train-step dispatch (jit.TrainStepCompiler)
+    serve_admit  serving-scheduler request admission
+    serve_decode serving-engine decode dispatch (LLMEngine)
 
 Spec grammar (PADDLE_CHAOS, `;`-separated rules):
 
@@ -81,6 +83,12 @@ SITES = {
                 "single-process _fetch)",
     "dispatch": "compiled train-step dispatch "
                 "(jit.TrainStepCompiler._run_compiled)",
+    "serve_admit": "serving-scheduler request admission "
+                   "(inference.serving.scheduler — delay = slow "
+                   "client)",
+    "serve_decode": "serving-engine decode dispatch "
+                    "(inference.serving.engine; resource_exhausted "
+                    "drives the mid-decode eviction path)",
 }
 
 FAULTS = {

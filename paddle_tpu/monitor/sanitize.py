@@ -32,6 +32,13 @@ Families (PADDLE_SANITIZE, `,`/`;`-separated, chaos-style grammar):
                 DistributedTrainStepCompiler to RAISE on
                 error-severity findings before compile (under plain
                 PADDLE_ANALYSIS=1 they only report).
+    serving     KV-block accounting in the serving engine
+                (inference.serving.kv_cache): double-free /
+                foreign-free of a pool block reports PTA071 at the
+                faulting call, and the allocator's
+                `audit_leaks(live)` / `LLMEngine.check_drained()`
+                report PTA070 for blocks still owned by requests
+                the engine no longer tracks.
     all / 1     every family.
 
     e.g.  PADDLE_SANITIZE=donation;locks:hold_ms=250
@@ -79,6 +86,8 @@ FAMILIES = {
              "census (PTA060/PTA061/PTA063)",
     "sharding": "strict mode for the PTA05x sharding-spec lints "
                 "(errors raise before compile)",
+    "serving": "KV-block leak/double-free accounting in the serving "
+               "engine (PTA070/PTA071)",
 }
 
 PARAMS = {
@@ -91,6 +100,7 @@ _armed = False
 _donation = False
 _locks = False
 _sharding = False
+_serving = False
 _spec = ""
 _opts: dict = {}
 
@@ -252,7 +262,8 @@ def configure(spec=None):
     """Arm the families a spec describes (default: $PADDLE_SANITIZE).
     Replaces any previous configuration; empty/unset disarms. Returns
     the armed {family: params} map."""
-    global _armed, _donation, _locks, _sharding, _spec, _opts
+    global _armed, _donation, _locks, _sharding, _serving, _spec, \
+        _opts
     if spec is None:
         spec = os.environ.get("PADDLE_SANITIZE", "")
     fams = parse_spec(spec) if spec else {}
@@ -260,6 +271,7 @@ def configure(spec=None):
     _donation = "donation" in fams
     _locks = "locks" in fams
     _sharding = "sharding" in fams
+    _serving = "serving" in fams
     _armed = bool(fams)
     _spec = str(spec) if fams else ""
     if fams:
@@ -278,8 +290,9 @@ def configure(spec=None):
 
 
 def disarm():
-    global _armed, _donation, _locks, _sharding, _spec, _opts
-    _armed = _donation = _locks = _sharding = False
+    global _armed, _donation, _locks, _sharding, _serving, _spec, \
+        _opts
+    _armed = _donation = _locks = _sharding = _serving = False
     _spec = ""
     _opts = {}
     # zero the gauge only if arming ever created it — stat_get/set
