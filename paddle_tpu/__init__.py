@@ -60,7 +60,14 @@ from . import quantization
 from . import cost_model
 from . import analysis
 from . import utils
-from . import linalg as _linalg_ns
+# `from .ops import *` above bound `linalg` to ops.linalg, so a bare
+# `from . import linalg` would see the attribute and silently skip
+# importing the PACKAGE (pre-ISSUE-12 the two surfaces were
+# identical, which hid this). Import through the submodule path and
+# rebind explicitly: paddle.linalg is the package, whose .dist is the
+# distributed tier.
+from .linalg import dist as _linalg_dist  # noqa: F401 — forces the package import
+linalg = _sys.modules[__name__ + ".linalg"]
 from . import fft
 from . import signal
 from . import version

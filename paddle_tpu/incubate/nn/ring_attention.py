@@ -138,13 +138,11 @@ def _pick_axis(mesh, a, dim):
 
 
 def _shard_map(body, mesh, in_specs, out_specs):
-    """shard_map across jax versions (check_vma was check_rep)."""
-    try:
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    except TypeError:
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False)
+    """The cross-version shard_map shim, shared with linalg.dist
+    (distributed.mesh.shard_map_compat)."""
+    from ...distributed.mesh import shard_map_compat
+
+    return shard_map_compat(body, mesh, in_specs, out_specs)
 
 
 def ulysses_attention(q, k, v, causal=True, sm_scale=None, mesh=None,

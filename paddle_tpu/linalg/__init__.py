@@ -1,0 +1,25 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py).
+
+Promoted to a package in ISSUE 12: the single-device op surface
+re-exports `ops.linalg` unchanged, and `paddle.linalg.dist` is now
+the SUMMA-style DISTRIBUTED tier over the Fleet mesh (ShardedMatrix +
+distributed matmul/Cholesky/TSQR/eigensolvers — ROADMAP item 4, per
+PAPERS.md arxiv 2112.09017). The p-norm distance op that used to sit
+at this name stays available as `paddle.dist` and
+`paddle.linalg.pdist_op` (the subpackage deliberately wins the
+`linalg.dist` attribute — the ISSUE-12 API contract)."""
+# the subpackage must import BEFORE the star re-export: the ops
+# surface also exports a `dist` (the p-norm distance op), and
+# `from . import dist` after the star would see the attribute already
+# bound and silently skip importing the subpackage
+from . import dist
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.linalg import __all__ as _OPS_ALL
+from ..ops.linalg import dist as pdist_op  # the shadowed distance op
+
+# the distributed subpackage wins the `dist` name (ISSUE 12)
+import sys as _sys
+
+dist = _sys.modules[__name__ + ".dist"]
+
+__all__ = list(_OPS_ALL) + ["pdist_op"]

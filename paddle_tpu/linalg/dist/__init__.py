@@ -1,0 +1,35 @@
+"""paddle.linalg.dist — SUMMA-style distributed linear algebra on the
+Fleet mesh (ISSUE 12 / ROADMAP item 4, per PAPERS.md arxiv
+2112.09017 "Large Scale Distributed Linear Algebra With TPUs").
+
+Dense matrices live in `ShardedMatrix` block layouts (NamedSharding /
+PartitionSpec over the live mesh); algorithms are shard_map islands
+compiled through the standard jit + persistent-compile-cache spine,
+their collectives routed through `distributed/collective.py` so
+comm/<op>/{calls,bytes} telemetry, the flight recorder, the
+`linalg_dispatch` chaos site, and the PTA05x sharding lints all apply
+exactly as they do to training and serving.
+
+    mesh = paddle.distributed.build_mesh({"dp": 2, "mp": 4})
+    paddle.distributed.set_mesh(mesh)
+    A = dist.shard(a_host)                    # blocks layout P(dp, mp)
+    C = dist.matmul(A, dist.shard(b_host))    # SUMMA
+    L = dist.cholesky(dist.shard(spd_host))   # blocked right-looking
+    Q, R = dist.qr(dist.shard(tall, layout="rows"))   # TSQR
+    w = dist.lanczos(A_sym, k=2)              # extreme eigenvalues
+    w, V = dist.eigsh(A_sym, k=8)             # subspace iteration
+
+Env: PADDLE_LINALG_AXES picks the grid axes, PADDLE_LINALG_BLOCK pins
+the SUMMA panel width, PADDLE_LINALG_AUTOTUNE=1 profiles panel
+candidates through cost_model.CostModel."""
+from .sharded import ShardedMatrix, shard
+from .summa import matmul, choose_block_size, block_candidates
+from .factorizations import cholesky, qr, tsqr
+from .eigen import matvec, lanczos, eigsh
+from .runtime import Grid, grid, clear_program_cache
+
+__all__ = [
+    "ShardedMatrix", "shard", "matmul", "choose_block_size",
+    "block_candidates", "cholesky", "qr", "tsqr", "matvec",
+    "lanczos", "eigsh", "Grid", "grid", "clear_program_cache",
+]

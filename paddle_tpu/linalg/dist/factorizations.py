@@ -1,0 +1,194 @@
+"""Distributed dense factorizations on the Fleet mesh.
+
+- `cholesky`: blocked RIGHT-LOOKING Cholesky on the 2D block layout.
+  Per panel k: the (nb, nb) diagonal block is 2D-broadcast and
+  factored redundantly (tiny), the owning grid column computes its
+  panel rows with a local triangular solve, the panel replicates via
+  a row broadcast + column all_gather (the collective tree), and
+  every rank applies one local rank-nb trailing update on the MXU.
+- `qr` (TSQR/CAQR): tall-skinny QR on the `rows` layout. Panel
+  factorization is LOCAL (each rank QRs its block row); the R factors
+  reduce through one all_gather tree and a second small QR; Q comes
+  back from one local matmul. Communication is p * n^2 elements,
+  independent of M.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import runtime
+from .sharded import ShardedMatrix
+
+__all__ = ["cholesky", "qr", "tsqr"]
+
+CHOLESKY_BLOCK_CAP = 128
+
+
+def _chol_block(N, grid_, block_size=None):
+    g = runtime.block_divisor(N, grid_.px, grid_.py)
+    if g <= 0:
+        raise ValueError(
+            f"paddle.linalg.dist.cholesky: matrix dim {N} does not "
+            f"tile the {grid_.px}x{grid_.py} grid")
+    if block_size:
+        nb = int(block_size)
+        if g % nb:
+            raise ValueError(
+                f"paddle.linalg.dist.cholesky: block_size {nb} must "
+                f"divide gcd(N/px, N/py) = {g}")
+        return nb
+    return max(d for d in range(1, g + 1)
+               if g % d == 0 and d <= CHOLESKY_BLOCK_CAP)
+
+
+def _build_cholesky(grid_, N, nb, dtype):
+    px, py = grid_.px, grid_.py
+    rb, cb = N // px, N // py
+
+    def body(a):
+        i = lax.axis_index(grid_.rx)
+        j = lax.axis_index(grid_.cx) if grid_.cx else 0
+        L = jnp.zeros_like(a)
+        gr = jnp.arange(N)
+        for k in range(N // nb):
+            g0 = k * nb
+            ik, jk = g0 // rb, g0 // cb
+            # (nb, nb) diagonal block, 2D broadcast from its owner
+            d = lax.slice(a, (g0 % rb, g0 % cb),
+                          (g0 % rb + nb, g0 % cb + nb))
+            d = runtime.bcast(d, grid_.all_axes(), ik * py + jk)
+            lkk = jnp.linalg.cholesky(d)
+            # panel: this rank's candidate rows, A[:, k] @ L_kk^{-T}.
+            # Non-owner columns compute garbage the broadcast masks.
+            pan = lax.slice_in_dim(a, g0 % cb, g0 % cb + nb, axis=1)
+            pan = jax.scipy.linalg.solve_triangular(
+                lkk, pan.T, lower=True).T
+            pan = runtime.bcast(pan, grid_.row_axes(), jk)
+            # replicate the full (N, nb) panel: the diagonal rows of
+            # A_kk @ L_kk^{-T} are exactly L_kk, rows above are stale
+            # -> masked to zero
+            pfull = runtime.gather(pan, grid_.col_axes())
+            pfull = pfull.reshape(N, nb)
+            pfull = jnp.where(gr[:, None] < g0, 0, pfull)
+            # the diagonal rows of A_kk @ L_kk^{-T} equal L_kk only up
+            # to solve roundoff — substitute the exactly-triangular
+            # factor so L's upper triangle is exactly zero
+            pfull = pfull.at[g0:g0 + nb, :].set(lkk)
+            mine = lax.dynamic_slice_in_dim(pfull, i * rb, rb, axis=0)
+            cur = lax.slice_in_dim(L, g0 % cb, g0 % cb + nb, axis=1)
+            written = jnp.where(jnp.equal(j, jk), mine, cur)
+            L = L.at[:, g0 % cb:g0 % cb + nb].set(written)
+            # trailing update A -= L[:,k] L[:,k]^T restricted to rows
+            # AND cols past the panel (earlier rows/cols zero out)
+            pm = jnp.where(gr[:, None] < g0 + nb, 0, pfull)
+            rows = lax.dynamic_slice_in_dim(pm, i * rb, rb, axis=0)
+            cols = lax.dynamic_slice_in_dim(pm, j * cb, cb, axis=0)
+            a = a - jnp.matmul(
+                rows, cols.T,
+                preferred_element_type=jnp.float32).astype(a.dtype)
+        return L
+
+    spec = grid_.block_spec()
+
+    def fn(a):
+        return runtime.shard_map(body, grid_.mesh, (spec,), spec)(a)
+
+    return fn
+
+
+def cholesky(a: ShardedMatrix, block_size=None) -> ShardedMatrix:
+    """Distributed lower-Cholesky of a symmetric positive-definite
+    matrix in the `blocks` layout. Returns L (lower triangular, same
+    layout) with A = L @ L.T."""
+    if not isinstance(a, ShardedMatrix):
+        raise TypeError(
+            "paddle.linalg.dist.cholesky expects a ShardedMatrix, "
+            f"got {type(a).__name__}")
+    if a.layout != "blocks":
+        raise ValueError(
+            "paddle.linalg.dist.cholesky needs the 'blocks' layout "
+            f"(got {a.layout!r})")
+    N, N2 = a.shape
+    if N != N2:
+        raise ValueError(
+            f"paddle.linalg.dist.cholesky: matrix must be square, "
+            f"got {a.shape}")
+    grid_ = a.grid
+    nb = _chol_block(N, grid_, block_size)
+    label = f"cholesky_{N}_nb{nb}_{a.dtype}"
+    compiled = runtime.compile_program(
+        label, lambda: _build_cholesky(grid_, N, nb, a.dtype),
+        grid_, (a.value,))
+    out = runtime.dispatch("factorizations", label, compiled,
+                           (a.value,))
+    return ShardedMatrix(out, grid_, layout="blocks", _validated=True)
+
+
+def _build_tsqr(grid_, M, n, dtype):
+    p = grid_.nranks
+    axes = grid_.all_axes()
+
+    def body(a):
+        q1, r1 = jnp.linalg.qr(a, mode="reduced")
+        # R-factor reduction: one all_gather tree + a small QR of the
+        # stacked (p*n, n) factors, computed redundantly on each rank
+        rs = runtime.gather(r1, axes)
+        q2, r = jnp.linalg.qr(rs.reshape(p * n, n), mode="reduced")
+        rank = runtime.flat_rank(grid_)
+        myq2 = lax.dynamic_slice_in_dim(q2, rank * n, n, axis=0)
+        q = jnp.matmul(q1, myq2,
+                       preferred_element_type=jnp.float32)
+        # sign-normalize diag(R) >= 0: the unique factor, directly
+        # comparable to any reference modulo its own sign convention
+        s = jnp.sign(jnp.diagonal(r))
+        s = jnp.where(s == 0, 1, s)
+        return ((q * s[None, :]).astype(dtype),
+                (r * s[:, None]).astype(dtype))
+
+    from jax.sharding import PartitionSpec as P
+
+    rspec = grid_.row_spec()
+
+    def fn(a):
+        return runtime.shard_map(body, grid_.mesh, (rspec,),
+                                 (rspec, P(None, None)))(a)
+
+    return fn
+
+
+def qr(a: ShardedMatrix):
+    """Distributed tall-skinny QR (TSQR) of a matrix in the `rows`
+    layout. Returns (Q ShardedMatrix in the same layout, R as a
+    replicated jax array) with A = Q @ R, Q.T @ Q = I and
+    diag(R) >= 0."""
+    if not isinstance(a, ShardedMatrix):
+        raise TypeError(
+            "paddle.linalg.dist.qr expects a ShardedMatrix, got "
+            f"{type(a).__name__}")
+    if a.layout != "rows":
+        raise ValueError(
+            "paddle.linalg.dist.qr runs TSQR on the 'rows' layout — "
+            f"shard(x, layout='rows') first (got {a.layout!r})")
+    M, n = a.shape
+    grid_ = a.grid
+    if M // grid_.nranks < n:
+        raise ValueError(
+            "paddle.linalg.dist.qr: TSQR needs each local block row "
+            f"at least as tall as wide — {M}x{n} over "
+            f"{grid_.nranks} ranks leaves {M // grid_.nranks} rows "
+            f"per rank (< {n})")
+    label = f"tsqr_{M}x{n}_{a.dtype}"
+    compiled = runtime.compile_program(
+        label, lambda: _build_tsqr(grid_, M, n, a.dtype),
+        grid_, (a.value,))
+    q, r = runtime.dispatch("factorizations", label, compiled,
+                            (a.value,))
+    # r comes back as the documented REPLICATED jax array (P(None,
+    # None) out-spec) — no host round-trip here
+    return (ShardedMatrix(q, grid_, layout="rows", _validated=True),
+            r)
+
+
+tsqr = qr

@@ -89,6 +89,9 @@ SITES = {
     "serve_decode": "serving-engine decode dispatch "
                     "(inference.serving.engine; resource_exhausted "
                     "drives the mid-decode eviction path)",
+    "linalg_dispatch": "distributed linear-algebra program dispatch "
+                       "(linalg.dist.runtime.dispatch — SUMMA/"
+                       "factorization/eigensolver programs)",
 }
 
 FAULTS = {

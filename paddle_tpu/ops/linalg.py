@@ -108,13 +108,26 @@ def dist(x, y, p=2, name=None):
 
 
 def cross(x, y, axis=9, name=None):
-    def _k(a, b, axis):
-        ax = axis
-        if ax == 9:  # paddle default: first axis with dim 3
-            ax = next(i for i, d in enumerate(a.shape) if d == 3)
-        return jnp.cross(a, b, axis=ax)
+    # resolve the axis-9 "first dim of size 3" sentinel HERE, on the
+    # static shapes, instead of inside the kernel: a shape with no
+    # size-3 dim used to escape as a bare StopIteration from next()
+    ax = int(axis) if axis is not None else 9
+    if ax == 9:  # paddle default: first axis with dim 3
+        xs = tuple(int(d) for d in np.shape(
+            x._value if isinstance(x, Tensor) else x))
+        ys = tuple(int(d) for d in np.shape(
+            y._value if isinstance(y, Tensor) else y))
+        ax = next((i for i, d in enumerate(xs) if d == 3), None)
+        if ax is None:
+            raise ValueError(
+                "paddle.cross: no dimension of size 3 to take the "
+                f"cross product over — x.shape={xs}, y.shape={ys}; "
+                "pass axis= explicitly")
 
-    return apply_op("cross", _k, x, y, axis=int(axis) if axis is not None else 9)
+    def _k(a, b, axis):
+        return jnp.cross(a, b, axis=axis)
+
+    return apply_op("cross", _k, x, y, axis=ax)
 
 
 def _simple(name, jfn):
@@ -247,11 +260,60 @@ def cond(x, p=None, name=None):
     return apply_op("cond", lambda v, p: jnp.linalg.cond(v, p=p), x, p=p)
 
 
-def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    def _k(v, rowvar, ddof):
-        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+def _cov_weight(w, nobs, what, integral):
+    """Validate a cov weight vector (np.cov's contract) and return it
+    as an operand Tensor/array. Validation runs on concrete values
+    only — under a trace the checks defer to the kernel math."""
+    v = w._value if isinstance(w, Tensor) else w
+    arr = None
+    try:
+        arr = np.asarray(v)
+    except Exception:
+        pass  # tracer: shape checks only
+    shape = tuple(np.shape(v))
+    if len(shape) != 1:
+        raise ValueError(
+            f"paddle.linalg.cov: {what} must be 1-D, got shape "
+            f"{shape}")
+    if shape[0] != nobs:
+        raise ValueError(
+            f"paddle.linalg.cov: {what} has {shape[0]} entries for "
+            f"{nobs} observations")
+    if arr is not None and arr.dtype != object:
+        if integral and not np.all(arr == np.round(arr)):
+            raise TypeError(
+                f"paddle.linalg.cov: {what} must be integer "
+                "frequency counts")
+        if np.any(arr < 0):
+            raise ValueError(
+                f"paddle.linalg.cov: {what} cannot be negative")
+    return w
 
-    return apply_op("cov", _k, x, rowvar=bool(rowvar), ddof=bool(ddof))
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Weighted covariance (np.cov semantics: fweights are integer
+    observation frequencies, aweights are importance weights; the
+    normalization follows np.cov's w_sum - ddof * sum(w*a) / w_sum)."""
+    xv = x._value if isinstance(x, Tensor) else x
+    xshape = tuple(np.shape(xv))
+    nobs = xshape[-1] if rowvar or len(xshape) < 2 else xshape[0]
+    operands = [x]
+    if fweights is not None:
+        operands.append(_cov_weight(fweights, nobs, "fweights", True))
+    if aweights is not None:
+        operands.append(_cov_weight(aweights, nobs, "aweights",
+                                    False))
+
+    def _k(v, *ws, rowvar, ddof, has_fw, has_aw):
+        ws = list(ws)
+        fw = ws.pop(0) if has_fw else None
+        aw = ws.pop(0) if has_aw else None
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    return apply_op("cov", _k, *operands, rowvar=bool(rowvar),
+                    ddof=bool(ddof), has_fw=fweights is not None,
+                    has_aw=aweights is not None)
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -1,0 +1,187 @@
+"""ISSUE 12 satellite coverage for the single-device linalg surface:
+
+- weighted `cov` (fweights/aweights) against np.cov, plus the
+  np.cov-contract validation errors
+- `cross` axis-9 sentinel pre-validation (a shape with no size-3 dim
+  used to escape as a bare StopIteration from inside the kernel)
+- eager-vs-compiled (`to_static`) parity for the decomposition ops —
+  test_op_coverage.py only checks eager values.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+RNG = np.random.default_rng(7)
+T = paddle.to_tensor
+
+
+def _spd(n):
+    m = RNG.standard_normal((n, n))
+    return (m @ m.T + n * np.eye(n)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# cov: weighted paths
+# ---------------------------------------------------------------------------
+
+X = RNG.standard_normal((3, 12)).astype(np.float32)
+FW = RNG.integers(1, 5, size=12)
+AW = RNG.uniform(0.5, 2.0, size=12).astype(np.float32)
+
+
+@pytest.mark.parametrize("kw,npkw", [
+    ({}, {}),
+    (dict(fweights=FW.astype(np.int32)), dict(fweights=FW)),
+    (dict(aweights=AW), dict(aweights=AW)),
+    (dict(fweights=FW.astype(np.int32), aweights=AW),
+     dict(fweights=FW, aweights=AW)),
+], ids=["plain", "fweights", "aweights", "both"])
+def test_cov_weighted_matches_numpy(kw, npkw):
+    got = np.asarray(paddle.linalg.cov(
+        T(X), **{k: T(v) for k, v in kw.items()}).numpy())
+    np.testing.assert_allclose(got, np.cov(X, **npkw), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cov_weighted_ddof_rowvar_combos():
+    for rowvar, ddof in ((True, False), (False, True), (False, False)):
+        xm = X if rowvar else X.T
+        got = np.asarray(paddle.linalg.cov(
+            T(xm), rowvar=rowvar, ddof=ddof,
+            fweights=T(FW.astype(np.int32)), aweights=T(AW)).numpy())
+        ref = np.cov(xm, rowvar=rowvar, ddof=1 if ddof else 0,
+                     fweights=FW, aweights=AW)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cov_weight_validation():
+    x = T(X)
+    with pytest.raises(ValueError, match="1-D"):
+        paddle.linalg.cov(x, fweights=T(np.ones((2, 6), np.int32)))
+    with pytest.raises(ValueError, match="entries"):
+        paddle.linalg.cov(x, fweights=T(np.ones(5, np.int32)))
+    with pytest.raises(TypeError, match="integer"):
+        paddle.linalg.cov(x, fweights=T(np.full(12, 1.5, np.float32)))
+    with pytest.raises(ValueError, match="negative"):
+        paddle.linalg.cov(x, aweights=T(np.full(12, -1.0, np.float32)))
+    with pytest.raises(ValueError, match="negative"):
+        paddle.linalg.cov(
+            x, fweights=T(np.full(12, -2, np.int32)))
+
+
+# ---------------------------------------------------------------------------
+# cross: axis-9 sentinel
+# ---------------------------------------------------------------------------
+
+def test_cross_default_axis_picks_first_dim3():
+    a = RNG.standard_normal((4, 3)).astype(np.float32)
+    b = RNG.standard_normal((4, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cross(T(a), T(b)).numpy()),
+        np.cross(a, b, axis=1), rtol=1e-6)
+    # dim-3 on axis 0 (and explicit axis)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cross(T(a.T), T(b.T)).numpy()),
+        np.cross(a.T, b.T, axis=0), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cross(T(a), T(b), axis=1).numpy()),
+        np.cross(a, b, axis=1), rtol=1e-6)
+
+
+def test_cross_no_dim3_raises_value_error_naming_shapes():
+    a = T(np.ones((4, 5), np.float32))
+    b = T(np.ones((4, 5), np.float32))
+    with pytest.raises(ValueError) as ei:
+        paddle.cross(a, b)
+    msg = str(ei.value)
+    assert "(4, 5)" in msg and "axis" in msg
+    # and specifically NOT a bare StopIteration escaping the kernel
+    assert not isinstance(ei.value, StopIteration)
+
+
+# ---------------------------------------------------------------------------
+# eager vs to_static parity of the decomposition ops
+# ---------------------------------------------------------------------------
+
+def _both(fn, *args):
+    """Run fn eagerly and through to_static; return both results as
+    flat numpy lists."""
+    eager = fn(*[T(a) for a in args])
+    compiled = to_static(fn)(*[T(a) for a in args])
+
+    def _flat(out):
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(o.numpy()) for o in out]
+        return [np.asarray(out.numpy())]
+
+    return _flat(eager), _flat(compiled)
+
+
+def _assert_parity(fn, *args, atol=1e-5):
+    eager, compiled = _both(fn, *args)
+    assert len(eager) == len(compiled)
+    for e, c in zip(eager, compiled):
+        np.testing.assert_allclose(c, e, rtol=1e-5, atol=atol)
+
+
+@pytest.mark.parametrize("mode", ["reduced", "complete"])
+def test_qr_parity_both_modes(mode):
+    a = RNG.standard_normal((6, 4)).astype(np.float32)
+
+    def fn(x):
+        return paddle.linalg.qr(x, mode=mode)
+
+    _assert_parity(fn, a)
+
+
+@pytest.mark.parametrize("upper", [False, True])
+def test_cholesky_parity(upper):
+    spd = _spd(8)
+
+    def fn(x):
+        return paddle.linalg.cholesky(x, upper=upper)
+
+    _assert_parity(fn, spd)
+    # and the upper factor really is the transpose of the lower
+    u = np.asarray(paddle.linalg.cholesky(T(spd), upper=True).numpy())
+    lo = np.asarray(paddle.linalg.cholesky(T(spd)).numpy())
+    np.testing.assert_allclose(u, lo.T, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("p,axis,keepdim", [
+    (None, None, False),
+    ("fro", None, False),
+    (2, None, False),
+    (1, 0, False),
+    (1, 1, True),
+    (2, -1, True),
+    (np.inf, 1, False),
+    (-np.inf, 0, True),
+    (0, 1, False),
+    (3, 1, False),
+    ("fro", (0, 1), True),
+    (2, (0, 1), False),
+], ids=lambda v: str(v).replace(" ", ""))
+def test_norm_parity_p_axis_keepdim(p, axis, keepdim):
+    a = RNG.standard_normal((4, 5)).astype(np.float32)
+
+    def fn(x):
+        return paddle.linalg.norm(x, p=p, axis=axis, keepdim=keepdim)
+
+    _assert_parity(fn, a)
+
+
+def test_slogdet_parity():
+    a = _spd(6)
+
+    def fn(x):
+        return paddle.linalg.slogdet(x)
+
+    _assert_parity(fn, a)
+    # value check against the reference while we are here
+    sign, logdet = np.asarray(fn(T(a)).numpy())
+    s_ref, l_ref = np.linalg.slogdet(a)
+    assert np.isclose(sign, s_ref) and np.isclose(logdet, l_ref,
+                                                  rtol=1e-5)
