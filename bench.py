@@ -620,6 +620,74 @@ def bench_serving(on_tpu):
                                            int(len(gaps) * 0.99))], 3)
     r["static_batching_tokens_s"] = round(sb_tps, 1)
     r["cb_vs_static"] = round(cb_tps / sb_tps, 3) if sb_tps else 0.0
+
+    # ISSUE-13 goodput-under-chaos twin: the SAME traffic through a
+    # 2-replica Router with a serve_decode fault storm armed (OOM
+    # churn + one replica kill) and tight queues — tokens/s, p50/p99
+    # inter-token latency, shed rate and failover count, against the
+    # clean continuous-batching number above. Embedded as
+    # extra.serve_resilience by main(), so every perf record is
+    # provably chaos-annotated (which faults, how many triggers, and
+    # what they cost).
+    from paddle_tpu.core import monitor as _cmon
+    from paddle_tpu.inference.serving import (EngineOverloaded,
+                                              Router)
+    from paddle_tpu.monitor import chaos as _chaos
+
+    keys = ("serve/shed", "serve/failovers", "serve/drains",
+            "serve/deadline_aborts", "serve/oom_evictions")
+    base = {k: _cmon.stat_get(k) for k in keys}
+    router = Router(model, replicas=2, max_batch=max(2, max_batch // 2),
+                    max_queue=1)
+    sheds = 0
+    try:
+        t0 = time.perf_counter()
+        with _chaos.inject("serve_decode", "resource_exhausted",
+                           after=4, every=5, times=3), \
+                _chaos.inject("serve_decode", "raise", after=12,
+                              times=1):
+            ids = []
+            for p in prompts:
+                while True:
+                    try:
+                        ids.append(router.submit(p,
+                                                 sampling=sampling))
+                        break
+                    except EngineOverloaded:
+                        sheds += 1      # shed-then-retry
+                        time.sleep(0.05)
+            router.wait(ids, timeout_s=600)
+            storm_dt = time.perf_counter() - t0
+            storm_gaps, storm_total = [], 0
+            for i in ids:
+                req = router.get_request(i)
+                ts = req.token_times
+                storm_gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+                storm_total += len(req.output_ids)
+                router.release(i)
+        assert not router.check_drained(), \
+            "resilience twin leaked KV blocks"
+    finally:
+        router.shutdown()
+    deltas = {k: _cmon.stat_get(k) - base[k] for k in keys}
+    storm_tps = storm_total / storm_dt if storm_dt else 0.0
+    storm_gaps = sorted(storm_gaps) or [0.0]
+    r["resilience"] = {
+        "storm_tokens_s": round(storm_tps, 1),
+        "goodput_vs_clean": (round(storm_tps / cb_tps, 3)
+                             if cb_tps else 0.0),
+        "itl_p50_ms": round(1e3 * storm_gaps[len(storm_gaps) // 2],
+                            3),
+        "itl_p99_ms": round(
+            1e3 * storm_gaps[min(len(storm_gaps) - 1,
+                                 int(len(storm_gaps) * 0.99))], 3),
+        "sheds": sheds,
+        "shed_rate": round(sheds / max(1, sheds + len(ids)), 4),
+        "failovers": deltas["serve/failovers"],
+        "counters": deltas,
+        "storm": ("serve_decode:resource_exhausted:after=4:every=5:"
+                  "times=3;serve_decode:raise:after=12:times=1"),
+    }
     return r
 
 
@@ -837,6 +905,16 @@ def main():
         results["serve"] = {
             k: v for k, v in stats.items()
             if k.startswith("serve/")}
+        # serving-resilience twin (ISSUE 13): the serving config's
+        # goodput-under-chaos record — tokens/s + p50/p99 ITL with a
+        # serve_decode fault storm (OOM churn + a replica kill)
+        # armed, shed rate and failover count, vs the clean
+        # continuous-batching number. A serving perf record that
+        # never names its failure behavior under load is only half a
+        # record (the 2605.25645 tail-behavior argument)
+        srv = results.get("serving")
+        if isinstance(srv, dict) and "resilience" in srv:
+            results["serve_resilience"] = srv.pop("resilience")
         # distributed-linalg attribution (ISSUE 12): program counts
         # and bytes processed behind the linalg config's GFLOP/s.
         # linalg/* counters only the dist tier produces; the comm

@@ -152,6 +152,12 @@ DIAGNOSTICS = {
                "without a KV release on the same path",
                "call allocator.release()/scheduler.finish() before "
                "discarding the request"),
+    "PTA073": (Severity.ERROR,
+               "exported requests never re-added: an "
+               "export_requests() result discarded or bound but "
+               "never read — the failover/drain handoff drops them",
+               "re-add every export (import_request), return it to "
+               "the caller, or retain it (orphan_exports)"),
 }
 
 

@@ -19,6 +19,13 @@ This module is the STATIC half (the CLI `--sanitize serving` leg):
     call (`release` / `free_one` / `finish` / `evict` / `abort`)
     anywhere on the same function body — the request's blocks have
     no terminal owner                                   (PTA072)
+  * an export-family call (`export_requests` / `export_request`)
+    whose returned exports are DISCARDED — a bare statement, or an
+    assignment to a name never read again in the function. Exported
+    requests retired their engine-side records (EXPORTED terminal
+    state); snapshots nobody re-adds (`import_request`) are requests
+    silently dropped on the failover/drain path — the ISSUE-13
+    drop-without-release class, one layer up          (PTA073)
 
 plus `audit_block_accounting(...)`, the programmatic wrapper tests
 and the engine drain path use to turn the runtime allocator state
@@ -37,6 +44,7 @@ _ALLOC_NAMES = ("alloc", "alloc_blocks")
 _RELEASE_NAMES = ("release", "free_one", "free", "finish", "evict",
                   "abort")
 _TRACKING_NAMES = ("running", "_running", "requests", "_requests")
+_EXPORT_NAMES = ("export_requests", "export_request")
 
 
 def _call_attr(node):
@@ -61,8 +69,9 @@ def _is_tracking(node):
 
 
 def lint_kv_source(source, filename="<string>", report=None):
-    """AST pass over one file: discarded alloc results (PTA070) and
-    request-drop-without-release paths (PTA072)."""
+    """AST pass over one file: discarded alloc results (PTA070),
+    request-drop-without-release paths (PTA072), and exported-but-
+    never-re-added failover snapshots (PTA073)."""
     report = report if report is not None else Report()
     try:
         tree = ast.parse(source, filename=filename)
@@ -80,9 +89,21 @@ def lint_kv_source(source, filename="<string>", report=None):
                 "never be freed",
                 file=filename, line=node.lineno,
                 severity=Severity.ERROR, analyzer="serving")
+        # discarded export result — the failover drop class (PTA073)
+        if isinstance(node, ast.Expr) and \
+                _call_attr(node.value) in _EXPORT_NAMES:
+            report.add(
+                "PTA073",
+                f"result of {_call_attr(node.value)}() is discarded "
+                "— the exported requests retired on this engine and "
+                "nobody can ever re-add them (import_request): they "
+                "are silently dropped",
+                file=filename, line=node.lineno,
+                severity=Severity.ERROR, analyzer="serving")
         if not isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
             continue
+        _lint_unused_exports(node, report, filename)
         drops, releases = [], False
         for sub in _walk_no_nested_defs(node):
             if isinstance(sub, ast.Call) and \
@@ -110,6 +131,31 @@ def lint_kv_source(source, filename="<string>", report=None):
                     file=filename, line=d.lineno,
                     analyzer="serving")
     return report
+
+
+def _lint_unused_exports(fdef, report, filename):
+    """PTA073 second form: `exports = eng.export_requests(...)` where
+    the bound name is never READ again in the function — the
+    snapshots exist but no path can re-add or hand them off."""
+    assigns = []  # (name, line)
+    for sub in _walk_no_nested_defs(fdef):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                and isinstance(sub.targets[0], ast.Name) \
+                and _call_attr(sub.value) in _EXPORT_NAMES:
+            assigns.append((sub.targets[0].id, sub.lineno))
+    for name, line in assigns:
+        reads = sum(
+            1 for sub in _walk_no_nested_defs(fdef)
+            if isinstance(sub, ast.Name) and sub.id == name
+            and isinstance(sub.ctx, ast.Load))
+        if not reads:
+            report.add(
+                "PTA073",
+                f"{fdef.name}: exports bound to {name!r} are never "
+                "read — the exported requests have no re-admission "
+                "path and are silently dropped",
+                file=filename, line=line,
+                severity=Severity.ERROR, analyzer="serving")
 
 
 def audit_block_accounting(allocator, live_owners=(), report=None,

@@ -14,18 +14,27 @@ the Gemma-on-Cloud-TPU serving comparison (arxiv 2605.25645):
                     (gpt2), per-request in-program sampling
   * `engine`        `LLMEngine.generate()` / `add_request()`
                     streaming front end, donated decode step through
-                    the persistent compile cache
+                    the persistent compile cache; ISSUE-13 lifecycle
+                    (drain/export/timeout/watchdog emergency export)
+  * `router`        `Router` — N health-checked threaded replicas,
+                    least-loaded routing, deterministic token-exact
+                    failover (ISSUE 13)
 
 The ragged paged-attention decode kernel itself lives with its PR-8
 siblings in `incubate.nn.pallas.paged_attention`.
 """
 from __future__ import annotations
 
-from .engine import LLMEngine
+from .engine import EngineTimeout, LLMEngine
 from .kv_cache import (BlockAllocator, NULL_BLOCK, PagedKVCache,
                        env_block_size, env_max_batch, env_pool_bytes)
-from .scheduler import Request, SamplingParams, Scheduler
+from .router import Router, env_heartbeat_s, env_replicas
+from .scheduler import (EngineOverloaded, Request, SamplingParams,
+                        Scheduler, env_deadline_s, env_max_queue)
 
 __all__ = ["LLMEngine", "SamplingParams", "Request", "Scheduler",
+           "Router", "EngineOverloaded", "EngineTimeout",
            "PagedKVCache", "BlockAllocator", "NULL_BLOCK",
-           "env_block_size", "env_max_batch", "env_pool_bytes"]
+           "env_block_size", "env_max_batch", "env_pool_bytes",
+           "env_max_queue", "env_deadline_s", "env_replicas",
+           "env_heartbeat_s"]

@@ -33,9 +33,32 @@ Failure path: a RESOURCE_EXHAUSTED dispatch (real, or injected at
 the `serve_decode` chaos site) evicts the youngest request and
 retries — serving degrades to a smaller batch instead of dying.
 
+Lifecycle (ISSUE 13 — the failure-policy ring):
+
+  * `drain(timeout_s)` — stop admitting (new intake sheds with
+    `EngineOverloaded`), run RUNNING requests to completion, then
+    EXPORT whatever is left (prompt + generated-so-far + sampling)
+    for token-exact re-admission elsewhere (`import_request` —
+    position-keyed sampling seeds make replay deterministic on ANY
+    engine). `serve/drains`, `serve_drain` chaos site + flight span.
+  * `generate(timeout_s=)` — raises `EngineTimeout` with the engine
+    state summary attached instead of hanging to drain forever. The
+    bound is judged BETWEEN dispatches; a dispatch wedged inside XLA
+    is the watchdog's jurisdiction, which is why
+  * `arm_incident_export()` registers a PR-3/6 incident hook: a
+    watchdog-detected wedge (stuck `serve_decode` span) fences the
+    engine and performs an emergency drain-and-export — in-flight
+    requests become `emergency_exports` a router/operator replays on
+    a healthy replica instead of dying with the wedged one.
+  * A FENCED engine (`_fenced`) no-ops `step()`: after a failover
+    exported its requests, a zombie thread waking from the wedge
+    cannot double-serve them.
+
 Telemetry: `serve/{requests,tokens,prefill_us,decode_us,evictions,
-queue_depth,kv_blocks/*}` counters plus `serve_prefill`/
-`serve_decode` flight spans, all through the PR-1/PR-3 monitor hub.
+queue_depth,drains,kv_blocks/*}` counters plus `serve_prefill`/
+`serve_decode`/`serve_drain` flight spans, all through the PR-1/PR-3
+monitor hub. `heartbeat` is stamped at every completed dispatch —
+the router's per-replica health signal.
 """
 from __future__ import annotations
 
@@ -50,10 +73,22 @@ from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
 from . import model_runner as _mr
 from .kv_cache import NULL_BLOCK, PagedKVCache, env_max_batch
-from .scheduler import (FINISHED, Request, SamplingParams,
-                        Scheduler)
+from .scheduler import (EngineOverloaded, EXPORTED, FINISHED,
+                        Request, SamplingParams, Scheduler)
 
-__all__ = ["LLMEngine"]
+__all__ = ["LLMEngine", "EngineTimeout"]
+
+
+class EngineTimeout(TimeoutError):
+    """`generate(timeout_s=)` ran out of budget with work still live.
+    Carries the engine's state summary in `.engine_state` — what was
+    waiting/running and how stale the heartbeat was, so the caller
+    (or the incident report) sees WHERE generation stood instead of
+    a bare hang-turned-timeout."""
+
+    def __init__(self, msg, engine_state=None):
+        super().__init__(msg)
+        self.engine_state = engine_state or {}
 
 
 class LLMEngine:
@@ -62,7 +97,7 @@ class LLMEngine:
     def __init__(self, model, max_batch=None, block_size=None,
                  num_blocks=None, pool_bytes=None, dtype=None,
                  static_batching=False, use_kernel=None,
-                 donate=True):
+                 donate=True, max_queue=None):
         import jax
 
         self.params, self.config = _mr.extract_params(model)
@@ -80,7 +115,8 @@ class LLMEngine:
             self.max_seq_len / self.block_size)
         self.scheduler = Scheduler(self.cache, self.max_batch,
                                    self.max_seq_len,
-                                   static_batching=static_batching)
+                                   static_batching=static_batching,
+                                   max_queue=max_queue)
         self._requests = {}          # req_id -> Request (all states)
         if use_kernel is None:
             from ...incubate.nn import pallas as _pl
@@ -110,12 +146,26 @@ class LLMEngine:
         # long-lived replica's host memory doesn't grow with total
         # traffic (generate() releases its own as it returns)
         self._keep_finished = 256
+        # -- resilience state (ISSUE 13) ------------------------------
+        # stamped at every COMPLETED dispatch: the router's health
+        # signal (a wedged dispatch stops the clock; an idle engine's
+        # stale beat is fine — health checks gate on has_unfinished)
+        self.heartbeat = time.monotonic()
+        # fenced = this engine's requests were exported elsewhere; a
+        # zombie thread waking from a wedge must not keep serving
+        self._fenced = False
+        # emergency drain-and-export landing zone (incident hook)
+        self.emergency_exports = None
+        self._incident_armed = False
 
     # -- request intake ----------------------------------------------
     def add_request(self, prompt_ids, sampling=None, on_token=None,
                     req_id=None):
         """Queue one request; returns its id. `on_token(req, token)`
-        streams every generated token as its dispatch completes."""
+        streams every generated token as its dispatch completes. A
+        FENCED engine refuses intake — its step() no-ops, so a
+        queued request would silently strand forever."""
+        self._check_fenced()
         req = Request(prompt_ids, sampling=sampling,
                       on_token=on_token, req_id=req_id)
         self.scheduler.add(req)
@@ -158,7 +208,11 @@ class LLMEngine:
     def step(self):
         """One engine iteration: admissions (each prefilled, its
         first token emitted) + one decode dispatch for the running
-        batch. Returns {req_id: token} emitted this step."""
+        batch. Returns {req_id: token} emitted this step. A fenced
+        engine (requests exported after a wedge/failover) no-ops —
+        its tokens would double-serve requests replaying elsewhere."""
+        if self._fenced:
+            return {}
         emitted = {}
 
         def _on_admit(req):
@@ -185,13 +239,48 @@ class LLMEngine:
             self._decode_batch(emitted)
         return emitted
 
-    def generate(self, prompts, sampling=None):
+    def generate(self, prompts, sampling=None, timeout_s=None):
         """Submit `prompts` (lists of token ids) and run the engine
-        to drain; returns each prompt's generated ids, in order."""
+        to drain; returns each prompt's generated ids, in order.
+
+        `timeout_s` bounds the WHOLE drain: when it elapses with work
+        still live, raises `EngineTimeout` carrying
+        `state_summary()` instead of looping forever (a queue the
+        pool can't serve, a steady stream of evict/readmit churn).
+        The bound is judged between dispatches — a dispatch wedged
+        INSIDE XLA is the watchdog's jurisdiction (see
+        `arm_incident_export`).
+
+        A request that EXPIRES (deadline_s) returns its partial —
+        for a never-admitted request, empty — output list in place:
+        deadline misses are a normal outcome under SLO load, counted
+        under serve/deadline_aborts. Callers that must distinguish
+        expiry per request should use add_request() + get_request()
+        and read `state`."""
         ids = [self.add_request(p, sampling=sampling)
                for p in prompts]
-        while self.has_unfinished():
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        while self.has_unfinished() and not self._fenced:
             self.step()
+            if deadline is not None and self.has_unfinished() \
+                    and time.monotonic() > deadline:
+                raise EngineTimeout(
+                    f"generate() exceeded timeout_s={timeout_s} "
+                    f"with {len(self.scheduler.running)} running / "
+                    f"{len(self.scheduler.waiting)} waiting",
+                    engine_state=self.state_summary())
+        exported = [i for i in ids
+                    if self._requests[i].state == EXPORTED]
+        if exported:
+            # an incident hook fenced this engine mid-generate and
+            # exported the work — partial outputs must not read as
+            # completed generations
+            raise EngineTimeout(
+                f"engine fenced mid-generate: {len(exported)} "
+                "request(s) were emergency-exported (see "
+                "emergency_exports) — replay them on a healthy "
+                "engine", engine_state=self.state_summary())
         outs = [self._requests[i].output_ids for i in ids]
         for i in ids:                # results consumed: release
             self.release_request(i)
@@ -237,6 +326,7 @@ class LLMEngine:
             tok = int(tok)
         _cmon.stat_add("serve/prefill_us",
                        int((time.perf_counter() - t0) * 1e6))
+        self.heartbeat = time.monotonic()
         return tok
 
     # -- decode ------------------------------------------------------
@@ -365,6 +455,7 @@ class LLMEngine:
             self.scheduler.evict(victim)
             return self._decode_batch(emitted)
         self._oom_streak = 0
+        self.heartbeat = time.monotonic()
         _cmon.stat_add("serve/decode_us",
                        int((time.perf_counter() - t0) * 1e6))
         for slot, req in list(self.scheduler.running.items()):
@@ -387,6 +478,192 @@ class LLMEngine:
                 or req.context_len >= self.max_seq_len)
         if done:
             self.scheduler.finish(req, state=FINISHED)
+
+    # -- lifecycle: drain / export / failover (ISSUE 13) -------------
+    @property
+    def fenced(self):
+        return self._fenced
+
+    def _check_fenced(self):
+        if self._fenced:
+            raise EngineOverloaded(
+                "engine is fenced (its requests were exported after "
+                "a wedge/failover) and will never serve again — "
+                "route to another replica or build a fresh "
+                "LLMEngine", engine_state=self.state_summary())
+
+    def heartbeat_age(self, now=None):
+        """Seconds since the last completed dispatch — the router's
+        wedge signal (meaningful only while the engine has work)."""
+        return (time.monotonic() if now is None else now) \
+            - self.heartbeat
+
+    def load_score(self):
+        """Free KV blocks NET of queued-but-not-yet-admitted demand
+        (prompt blocks + one decode lookahead per waiting request) —
+        the router's least-loaded signal. Counting the queue makes
+        back-to-back routing decisions see load the worker thread
+        hasn't admitted yet. list() snapshots the deque atomically
+        (C-level copy) so a concurrent admission pass can't raise
+        mutated-during-iteration under the router's read."""
+        pending = sum(
+            self.cache.blocks_for_tokens(r.context_len) + 1
+            for r in list(self.scheduler.waiting))
+        return self.cache.allocator.free_blocks - pending
+
+    def state_summary(self):
+        """Host-side snapshot of where serving stands — attached to
+        EngineTimeout/shed errors and flight records so a refused or
+        abandoned request names the engine state that refused it."""
+        sched = self.scheduler
+        return {
+            "waiting": len(sched.waiting),
+            "running": len(sched.running),
+            "draining": sched.draining,
+            "fenced": self._fenced,
+            "queue_depth": len(sched.waiting),
+            "free_blocks": self.cache.allocator.free_blocks,
+            "used_blocks": self.cache.allocator.used_blocks,
+            "oom_streak": self._oom_streak,
+            "heartbeat_age_s": round(self.heartbeat_age(), 3),
+        }
+
+    def _export(self, req):
+        """One request's replayable snapshot: everything another
+        engine needs to continue it TOKEN-EXACTLY (the position-keyed
+        sampling seeds make the remaining tokens a pure function of
+        prompt + generated-so-far + sampling)."""
+        return {
+            "req_id": req.req_id,
+            "prompt_ids": list(req.prompt_ids),
+            "output_ids": list(req.output_ids),
+            "sampling": req.sampling,
+            "deadline": req.deadline,
+            "evictions": req.evictions,
+        }
+
+    def export_requests(self, fence=True):
+        """Snapshot + retire every live request (EXPORTED terminal
+        state — blocks release NOW, so even a dead replica's
+        allocator audits clean) and by default FENCE the engine so a
+        zombie thread can't keep serving the originals. RUNNING
+        requests export first (admission order — most progress
+        resumes soonest), then the waiting queue in FIFO order.
+        The exports MUST be re-added somewhere (`import_request`) or
+        the requests are silently dropped — the PTA073 lint class."""
+        if fence:
+            self._fenced = True
+        sched = self.scheduler
+        running = sorted(
+            sched.running.values(),
+            key=lambda r: sched._admitted_at.get(r.req_id, -1))
+        live = running + list(sched.waiting)
+        exports = []
+        for req in live:
+            req.on_token = None   # zombie emits must not stream
+            exports.append(self._export(req))
+            sched.finish(req, state=EXPORTED)
+        return exports
+
+    def import_request(self, export, on_token=None, force=False):
+        """Re-admit an exported request (failover/drain handoff):
+        the preserved output_ids ride into the re-prefill exactly
+        like an eviction's recompute-on-readmit, so generation
+        continues where the exporting engine stopped. `force=True`
+        (router failover) bypasses the drain gate and shed bound —
+        the request already holds an admission promise. A fenced
+        engine refuses even forced imports: it will never step."""
+        self._check_fenced()
+        req = Request(export["prompt_ids"],
+                      sampling=export["sampling"],
+                      on_token=on_token,
+                      req_id=export["req_id"])
+        req.output_ids = list(export["output_ids"])
+        req.deadline = export.get("deadline")
+        req.evictions = int(export.get("evictions", 0))
+        self.scheduler.add(req, force=force)
+        self._requests[req.req_id] = req
+        return req.req_id
+
+    def drain(self, timeout_s=None):
+        """Graceful drain: stop admitting (new `add_request` sheds
+        with EngineOverloaded), run RUNNING requests to completion,
+        then export whatever is left — still-running requests that
+        outlived `timeout_s` plus the whole waiting queue — for
+        re-admission elsewhere. Returns the export list ([] when
+        everything completed). The engine stays draining afterwards;
+        `resume()` re-opens admission."""
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        with _flight.in_flight("serve_drain", "drain",
+                               running=len(self.scheduler.running),
+                               waiting=len(self.scheduler.waiting)):
+            if _chaos._armed:
+                _chaos.hit("serve_drain",
+                           running=len(self.scheduler.running))
+            self.scheduler.draining = True
+            while self.scheduler.running and not self._fenced:
+                if deadline is not None \
+                        and time.monotonic() > deadline:
+                    break
+                self.step()
+            exports = self.export_requests(fence=False)
+            if self.emergency_exports:
+                # the watchdog incident hook fenced this engine
+                # MID-drain and already exported the in-flight work;
+                # fold it into the return so the caller's "re-add
+                # everything drain() returns" contract still covers
+                # every request (returning [] here would read as
+                # 'all completed' — the PTA073 drop class)
+                exports = list(self.emergency_exports) + exports
+                self.emergency_exports = None
+        _cmon.stat_add("serve/drains", 1)
+        _flight.record("serve_drain_done", exported=len(exports))
+        return exports
+
+    def resume(self):
+        """Re-open admission after a drain (a replica rejoining the
+        router pool). A FENCED engine cannot resume — its requests
+        were exported and its pools may be mid-wedge; build a fresh
+        engine instead."""
+        if self._fenced:
+            raise RuntimeError(
+                "cannot resume a fenced engine — its requests were "
+                "exported after a wedge/failover; create a fresh "
+                "LLMEngine (the persistent compile cache makes that "
+                "a warm start)")
+        self.scheduler.draining = False
+
+    # -- watchdog emergency drain-and-export -------------------------
+    def arm_incident_export(self):
+        """Register the PR-3/6 incident hook: when the watchdog dumps
+        on a wedged dispatch (a stuck `serve_prefill`/`serve_decode`
+        span), fence this engine and export its in-flight requests
+        into `emergency_exports` — the autopsy bundle gains a
+        REPLAYABLE workload instead of just a stack trace, and a
+        router replays it on a healthy replica."""
+        if not self._incident_armed:
+            _flight.add_incident_hook(self._incident_export)
+            self._incident_armed = True
+        return self
+
+    def disarm_incident_export(self):
+        if self._incident_armed:
+            _flight.remove_incident_hook(self._incident_export)
+            self._incident_armed = False
+
+    def _incident_export(self, reason):
+        """Incident-hook body (best-effort by the PR-3 contract).
+        Only a wedge with live work exports; an idle engine has
+        nothing at stake. NO dispatches run here — the dispatch IS
+        what wedged."""
+        if self._fenced or not self.scheduler.has_work():
+            return
+        exports = self.export_requests(fence=True)
+        self.emergency_exports = exports
+        _cmon.stat_add("serve/drains", 1)
+        _flight.record("serve_drain_done", exported=len(exports),
+                       emergency=True, reason=str(reason))
 
     # -- accounting --------------------------------------------------
     def check_drained(self):

@@ -28,13 +28,39 @@ policy half (the engine owns the dispatches):
     serve-a-batch-drain-a-batch policy — the bench twin that measures
     what continuous batching buys.
 
+Overload/SLO policy (ISSUE 13 — the robustness ring production TPU
+serving is won on, per the arxiv 2605.25645 comparison):
+
+  * DEADLINES — `SamplingParams(deadline_s=)` (default
+    `PADDLE_SERVE_DEADLINE_S`, 0 = none) stamps the request with an
+    absolute expiry at arrival. Every admission pass first sweeps the
+    waiting queue for expired entries and retires them to the
+    `EXPIRED` terminal state (`serve/deadline_aborts`) — a request
+    that waited past its SLO must not burn prefill + decode HBM on an
+    answer nobody is waiting for. RUNNING requests are never
+    deadline-killed mid-decode: they already paid prefill, finishing
+    them is the cheaper path.
+  * LOAD SHEDDING — `max_queue` (default `PADDLE_SERVE_MAX_QUEUE`,
+    0 = unbounded) bounds the waiting queue; `add()` on a full queue
+    raises `EngineOverloaded` (`serve/shed`) instead of queueing
+    unboundedly. Expired entries are swept before the bound is
+    judged, so a queue full of corpses can't shed live traffic.
+    Eviction requeues bypass the bound: an evicted request already
+    holds an admission promise.
+  * PRIORITY-AWARE EVICTION — victims are picked lowest-`priority`
+    first, then latest-deadline (most slack loses the least), then
+    youngest-admitted (the PR-10 vLLM policy as the final tiebreak).
+
 Every state change feeds the PR-1 monitor hub: `serve/requests`,
-`serve/evictions`, `serve/queue_depth` (gauge), and the engine adds
-tokens/latency counters around the dispatches.
+`serve/evictions`, `serve/queue_depth` (gauge), `serve/shed`,
+`serve/deadline_aborts`, and the engine adds tokens/latency counters
+around the dispatches.
 """
 from __future__ import annotations
 
 import itertools
+import math
+import time
 from collections import deque
 
 from ...core import monitor as _cmon
@@ -42,35 +68,122 @@ from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
 
 __all__ = ["SamplingParams", "Request", "Scheduler",
-           "WAITING", "RUNNING", "FINISHED", "ABORTED"]
+           "EngineOverloaded", "env_max_queue", "env_deadline_s",
+           "WAITING", "RUNNING", "FINISHED", "ABORTED", "EXPIRED",
+           "EXPORTED"]
 
 WAITING = "waiting"
 RUNNING = "running"
 FINISHED = "finished"
 ABORTED = "aborted"
+EXPIRED = "expired"      # deadline passed while WAITING (ISSUE 13)
+EXPORTED = "exported"    # handed off for replay on another engine
+
+_TERMINAL = (FINISHED, ABORTED, EXPIRED, EXPORTED)
+
+
+def env_max_queue():
+    """PADDLE_SERVE_MAX_QUEUE — waiting-queue bound before `add()`
+    sheds with EngineOverloaded (default 0 = unbounded)."""
+    return max(0, _flight._env_int("PADDLE_SERVE_MAX_QUEUE", 0))
+
+
+def env_deadline_s():
+    """PADDLE_SERVE_DEADLINE_S — default per-request deadline in
+    seconds (default 0 = no deadline)."""
+    return max(0.0, _flight._env_float("PADDLE_SERVE_DEADLINE_S",
+                                       0.0))
+
+
+class EngineOverloaded(RuntimeError):
+    """Load shedding: the waiting queue is at `max_queue` (or the
+    engine is draining) — the caller should back off and retry, or
+    route to another replica. Carries the shedding engine's state
+    summary in `.engine_state` when the engine raised it."""
+
+    def __init__(self, msg, engine_state=None):
+        super().__init__(msg)
+        self.engine_state = engine_state or {}
+
+
+def _int_like(v):
+    """True for ints and integer numpy scalars; False for bools,
+    floats, strings — the types the compiled sampler would either
+    silently coerce or crash on mid-dispatch."""
+    if isinstance(v, bool):
+        return False
+    if isinstance(v, int):
+        return True
+    # numpy integer scalars without importing numpy here
+    return (hasattr(v, "dtype")
+            and getattr(v.dtype, "kind", "") in ("i", "u")
+            and getattr(v, "ndim", 1) == 0)
 
 
 class SamplingParams:
     """Per-request generation controls (the vLLM surface, trimmed to
-    what the compiled sampler implements)."""
+    what the compiled sampler implements) plus the ISSUE-13 SLO
+    fields: `deadline_s` (wall-clock budget from arrival; expired
+    WAITING requests retire as EXPIRED at admission) and `priority`
+    (higher survives eviction longer).
+
+    Every field is validated HERE, at intake — a negative `top_k`
+    would otherwise flow uncaught into the compiled double-argsort
+    sampler and mask every logit, and a float `seed` would crash the
+    uint32 cast inside a dispatch instead of at the API edge."""
 
     def __init__(self, max_new_tokens=16, temperature=0.0, top_k=0,
-                 eos_token_id=None, stop_token_ids=(), seed=0):
+                 eos_token_id=None, stop_token_ids=(), seed=0,
+                 deadline_s=None, priority=0):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
+        if not _int_like(top_k):
+            raise ValueError(
+                f"top_k must be an int, got {type(top_k).__name__} "
+                f"({top_k!r})")
+        if top_k < 0:
+            raise ValueError(
+                f"top_k must be >= 0 (0 = no filtering), got "
+                f"{top_k} — a negative k would mask every logit in "
+                "the compiled rank-filter sampler")
+        if not _int_like(seed):
+            raise ValueError(
+                f"seed must be an int, got {type(seed).__name__} "
+                f"({seed!r})")
+        if eos_token_id is not None and not _int_like(eos_token_id):
+            raise ValueError(
+                f"eos_token_id must be an int or None, got "
+                f"{type(eos_token_id).__name__} ({eos_token_id!r})")
+        stop_token_ids = tuple(stop_token_ids)
+        for t in stop_token_ids:
+            if not _int_like(t):
+                raise ValueError(
+                    f"stop_token_ids must be ints, got "
+                    f"{type(t).__name__} ({t!r})")
+        if deadline_s is None:
+            deadline_s = env_deadline_s() or None
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (None = no deadline), got "
+                f"{deadline_s}")
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
-        self.eos_token_id = eos_token_id
-        self.stop_token_ids = tuple(stop_token_ids)
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.stop_token_ids = tuple(int(t) for t in stop_token_ids)
         self.seed = int(seed)
+        self.deadline_s = (None if deadline_s is None
+                           else float(deadline_s))
+        self.priority = int(priority)
 
     def __repr__(self):
         return (f"SamplingParams(max_new_tokens="
                 f"{self.max_new_tokens}, temperature="
-                f"{self.temperature}, top_k={self.top_k})")
+                f"{self.temperature}, top_k={self.top_k}, "
+                f"priority={self.priority})")
 
 
 class Request:
@@ -92,6 +205,20 @@ class Request:
         self.slot = None           # decode batch slot while RUNNING
         self.evictions = 0
         self.token_times = []      # perf_counter per emitted token
+        self.arrival = time.monotonic()
+        # absolute expiry (monotonic); None = no SLO. Survives
+        # eviction/export so a replayed request keeps its budget.
+        self.deadline = (self.arrival + self.sampling.deadline_s
+                         if self.sampling.deadline_s else None)
+
+    @property
+    def priority(self):
+        return self.sampling.priority
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (time.monotonic() if now is None else now)
+                > self.deadline)
 
     @property
     def context_len(self):
@@ -100,7 +227,7 @@ class Request:
 
     @property
     def finished(self):
-        return self.state in (FINISHED, ABORTED)
+        return self.state in _TERMINAL
 
     def stop_hit(self, token):
         s = self.sampling
@@ -118,23 +245,56 @@ class Scheduler:
     decode batch width."""
 
     def __init__(self, cache, max_batch, max_seq_len,
-                 static_batching=False):
+                 static_batching=False, max_queue=None):
         self.cache = cache
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.static_batching = bool(static_batching)
+        self.max_queue = (env_max_queue() if max_queue is None
+                          else max(0, int(max_queue)))
+        self.draining = False      # drain(): stop admitting
         self.waiting = deque()
         self.running = {}          # slot -> Request
         self._admit_seq = itertools.count()
         self._admitted_at = {}     # req_id -> admission ordinal
 
     # -- queue -------------------------------------------------------
-    def add(self, request):
+    def add(self, request, force=False):
+        """Queue a request. `force=True` bypasses the drain gate and
+        the shed bound — failover re-admission only: an exported
+        request already holds an admission promise from the replica
+        that lost it, and dropping it to a full queue would break the
+        router's every-request-completes contract."""
         if request.context_len >= self.max_seq_len:
             raise ValueError(
                 f"{request.req_id}: prompt ({request.context_len}) "
                 f"leaves no room under max_seq_len="
                 f"{self.max_seq_len}")
+        if force:
+            request.state = WAITING
+            self.waiting.append(request)
+            self._sync_depth()
+            return request
+        if self.draining:
+            _cmon.stat_add("serve/shed", 1)
+            _flight.record("serve_shed", req=request.req_id,
+                           reason="draining")
+            raise EngineOverloaded(
+                f"{request.req_id}: engine is draining — retry on "
+                "another replica or after resume()")
+        if self.max_queue and len(self.waiting) >= self.max_queue:
+            # sweep corpses first: a queue full of already-expired
+            # entries must not shed live traffic
+            self.expire_waiting()
+            if len(self.waiting) >= self.max_queue:
+                _cmon.stat_add("serve/shed", 1)
+                _flight.record("serve_shed", req=request.req_id,
+                               reason="queue_full",
+                               depth=len(self.waiting))
+                raise EngineOverloaded(
+                    f"{request.req_id}: waiting queue full "
+                    f"({len(self.waiting)} >= max_queue="
+                    f"{self.max_queue}) — load shed")
         request.state = WAITING
         self.waiting.append(request)
         self._sync_depth()
@@ -157,6 +317,23 @@ class Scheduler:
         return [s for s in range(self.max_batch)
                 if s not in self.running]
 
+    def expire_waiting(self, now=None):
+        """Retire WAITING requests whose deadline passed (EXPIRED
+        terminal state, `serve/deadline_aborts`). Runs at the head of
+        every admission pass AND before the shed bound is judged —
+        admission is the last point a dead-on-arrival request can be
+        dropped for free (no pool blocks, no prefill). Returns the
+        expired requests."""
+        now = time.monotonic() if now is None else now
+        expired = [r for r in self.waiting if r.expired(now)]
+        for req in expired:
+            self.waiting.remove(req)
+            self.finish(req, state=EXPIRED)
+            _cmon.stat_add("serve/deadline_aborts", 1)
+        if expired:
+            self._sync_depth()
+        return expired
+
     def schedule(self, on_admit=None):
         """Admit as many waiting requests as slots + pool allow.
         `on_admit(req)` runs IMMEDIATELY after each admission (the
@@ -164,8 +341,13 @@ class Scheduler:
         an admission-site chaos raise for request N+1 — can never
         strand request N admitted-but-never-prefilled; the chaos hit
         itself fires BEFORE the request takes any pool resources.
-        Static-batching mode only admits into an EMPTY batch."""
+        Expired waiting requests retire first; a draining scheduler
+        admits nothing (running requests still finish). Static-
+        batching mode only admits into an EMPTY batch."""
         admitted = []
+        self.expire_waiting()
+        if self.draining:
+            return admitted
         if self.static_batching and self.running:
             return admitted
         slots = self._free_slots()
@@ -220,13 +402,18 @@ class Scheduler:
         return True
 
     def _pick_victim(self, exclude=None):
-        """Youngest-admitted running request (vLLM policy: the newest
-        request loses the least recompute work)."""
+        """Eviction victim, worst SLO position first: lowest
+        `priority`, then latest deadline (no deadline = infinitely
+        late — the most slack loses the least by recomputing), then
+        youngest-admitted (the PR-10 vLLM recompute policy as the
+        final tiebreak)."""
         cands = [r for r in self.running.values() if r is not exclude]
         if not cands:
             return None
-        return max(cands,
-                   key=lambda r: self._admitted_at.get(r.req_id, -1))
+        return max(cands, key=lambda r: (
+            -r.priority,
+            r.deadline if r.deadline is not None else math.inf,
+            self._admitted_at.get(r.req_id, -1)))
 
     def evict(self, request):
         """Preempt a running request: free its blocks NOW, requeue it
@@ -243,18 +430,29 @@ class Scheduler:
 
     # -- completion --------------------------------------------------
     def finish(self, request, state=FINISHED):
+        """Terminal transition from ANY state: releases blocks, and
+        removes a still-queued entry so no terminal path
+        (finish/abort/expire/export) can leave a corpse in the
+        waiting deque with `serve/queue_depth` overcounting — the
+        router failover hot path aborts WAITING requests. The deque
+        scan is gated on the WAITING state (only add/_requeue_front
+        put requests there), so the common RUNNING-completion path
+        stays O(1) under a deep backlog."""
+        was_waiting = request.state == WAITING
         request.state = state
         if request.slot is not None:
             self.running.pop(request.slot, None)
             request.slot = None
+        if was_waiting and request in self.waiting:
+            self.waiting.remove(request)
+            self._sync_depth()
         self.cache.allocator.release(request.req_id)
         self._admitted_at.pop(request.req_id, None)
         _flight.record("serve_finish", req=request.req_id,
                        tokens=len(request.output_ids), state=state)
 
     def abort(self, request):
-        """Cancel wherever it is; blocks release immediately."""
-        if request in self.waiting:
-            self.waiting.remove(request)
-            self._sync_depth()
+        """Cancel wherever it is; blocks release immediately and a
+        queued entry leaves the waiting deque (+ depth gauge) in the
+        same call."""
         self.finish(request, state=ABORTED)

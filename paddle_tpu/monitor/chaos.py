@@ -20,6 +20,8 @@ Sites (see SITES; `python -m paddle_tpu.monitor chaos` lists them):
     dispatch     compiled train-step dispatch (jit.TrainStepCompiler)
     serve_admit  serving-scheduler request admission
     serve_decode serving-engine decode dispatch (LLMEngine)
+    serve_route  serving-router replica selection (Router)
+    serve_drain  serving-engine graceful drain (LLMEngine.drain)
 
 Spec grammar (PADDLE_CHAOS, `;`-separated rules):
 
@@ -89,6 +91,12 @@ SITES = {
     "serve_decode": "serving-engine decode dispatch "
                     "(inference.serving.engine; resource_exhausted "
                     "drives the mid-decode eviction path)",
+    "serve_route": "serving-router replica selection "
+                   "(inference.serving.router — raise = routing "
+                   "layer failure before any replica is touched)",
+    "serve_drain": "serving-engine graceful drain entry "
+                   "(inference.serving.engine.drain — raise = drain "
+                   "aborted before any request is exported)",
     "linalg_dispatch": "distributed linear-algebra program dispatch "
                        "(linalg.dist.runtime.dispatch — SUMMA/"
                        "factorization/eigensolver programs)",

@@ -165,6 +165,54 @@ class TestPagedKVCache:
 # scheduler
 # ---------------------------------------------------------------------------
 
+class TestSamplingParamsValidation:
+    """ISSUE-13 satellite: every SamplingParams field is validated at
+    the API edge — bad values must raise clear ValueErrors HERE, not
+    crash (or silently misbehave) inside a compiled dispatch."""
+
+    def test_negative_top_k_rejected(self):
+        # a negative k used to flow uncaught into the compiled
+        # double-argsort sampler (ranks < k masks EVERY logit)
+        with pytest.raises(ValueError, match="top_k"):
+            SamplingParams(top_k=-1)
+
+    def test_non_int_top_k_rejected(self):
+        for bad in (1.5, "5", True):
+            with pytest.raises(ValueError, match="top_k"):
+                SamplingParams(top_k=bad)
+
+    def test_top_k_zero_and_numpy_int_ok(self):
+        assert SamplingParams(top_k=0).top_k == 0
+        assert SamplingParams(top_k=np.int32(7)).top_k == 7
+
+    def test_seed_type_validated(self):
+        for bad in (1.5, "7", None, True):
+            with pytest.raises(ValueError, match="seed"):
+                SamplingParams(seed=bad)
+        assert SamplingParams(seed=np.int64(3)).seed == 3
+
+    def test_stop_token_ids_element_types(self):
+        with pytest.raises(ValueError, match="stop_token_ids"):
+            SamplingParams(stop_token_ids=(1, "eos"))
+        with pytest.raises(ValueError, match="stop_token_ids"):
+            SamplingParams(stop_token_ids=[2.5])
+        assert SamplingParams(
+            stop_token_ids=(1, np.int32(2))).stop_token_ids == (1, 2)
+
+    def test_eos_token_id_validated(self):
+        with pytest.raises(ValueError, match="eos_token_id"):
+            SamplingParams(eos_token_id="2")
+        assert SamplingParams(eos_token_id=None).eos_token_id is None
+
+    def test_deadline_validated(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            SamplingParams(deadline_s=0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            SamplingParams(deadline_s=-1.5)
+        assert SamplingParams(deadline_s=2.5).deadline_s == 2.5
+        assert SamplingParams().deadline_s is None
+
+
 def _mk_cache(num_blocks=32, block_size=4):
     return PagedKVCache(1, 2, 8, block_size=block_size,
                         num_blocks=num_blocks)
@@ -257,6 +305,29 @@ class TestScheduler:
         s.abort(r0)  # running
         assert not s.running
         assert s.cache.allocator.used_blocks == 0
+
+    def test_abort_waiting_removes_deque_entry_and_syncs_depth(self):
+        """ISSUE-13 satellite regression: aborting a WAITING request
+        must remove its deque entry AND re-sync serve/queue_depth in
+        the SAME call — abort-while-queued is the router failover's
+        hot path, and a stale entry would be re-admitted as a ghost
+        after its record was exported elsewhere."""
+        s = Scheduler(_mk_cache(), max_batch=1, max_seq_len=64)
+        reqs = [Request([1] * 4, req_id=f"q{i}") for i in range(3)]
+        for r in reqs:
+            s.add(r)
+        assert cmon.stat_get("serve/queue_depth") == 3
+        s.abort(reqs[1])  # middle of the deque, never admitted
+        assert reqs[1] not in s.waiting
+        assert reqs[1].finished
+        assert cmon.stat_get("serve/queue_depth") == 2
+        # remaining order preserved; the ghost never admits
+        admitted = s.schedule()
+        assert [r.req_id for r in admitted] == ["q0"]
+        s.abort(reqs[0]), s.abort(reqs[2])
+        assert cmon.stat_get("serve/queue_depth") == 0
+        assert s.cache.allocator.used_blocks == 0
+        assert s.cache.allocator.audit_leaks([]) == {}
 
 
 # ---------------------------------------------------------------------------
@@ -800,8 +871,21 @@ class TestServingDocDrift:
     def test_serving_section_and_codes(self):
         doc = self._readme()
         assert "## Serving" in doc
-        for code in ("PTA070", "PTA071", "PTA072"):
+        for code in ("PTA070", "PTA071", "PTA072", "PTA073"):
             assert code in doc, f"{code} missing from README"
-        for site in ("serve_admit", "serve_decode"):
+        for site in ("serve_admit", "serve_decode", "serve_route",
+                     "serve_drain"):
             assert site in doc, f"chaos site {site} undocumented"
+
+    def test_resilience_section(self):
+        """ISSUE-13 satellite: the README documents the resilience
+        surface — deadline/shed/drain/router API and counters."""
+        doc = self._readme()
+        assert "Serving resilience" in doc
+        for word in ("Router", "drain(", "EngineOverloaded",
+                     "EngineTimeout", "deadline_s", "priority",
+                     "serve/failovers", "serve/shed",
+                     "serve/deadline_aborts", "serve/drains",
+                     "import_request"):
+            assert word in doc, f"{word!r} missing from README"
         assert "LLMEngine" in doc

@@ -1,0 +1,785 @@
+"""ISSUE 13: serving resilience — deadlines, load shedding, graceful
+drain, and the health-checked multi-replica router.
+
+Three rings over the PR-10 engine, each chaos/e2e-gated:
+
+  * SLO scheduling — deadline expiry at admission (EXPIRED terminal
+    state, racing admission), bounded-queue load shedding
+    (EngineOverloaded + serve/shed), priority/latest-deadline-aware
+    eviction.
+  * Lifecycle — drain()/export/import token-exact handoff,
+    generate(timeout_s=) raising EngineTimeout with engine state,
+    the watchdog incident hook's emergency drain-and-export.
+  * Router — least-loaded routing, replica crash AND wedge failover
+    replaying in-flight requests TOKEN-IDENTICALLY (the acceptance
+    gate: mid-flood replica kill, outputs equal the fault-free
+    single-replica run, zero leaked KV blocks, serve/failovers > 0
+    in the telemetry snapshot), shed-then-retry on a drained router,
+    orphan retention when every replica dies (the PTA073 story).
+
+Every failure-matrix case asserts zero leaked KV blocks via
+`check_drained()` + the PTA070 `audit_block_accounting` report.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.inference.serving import (EngineOverloaded,
+                                          EngineTimeout, LLMEngine,
+                                          PagedKVCache, Router,
+                                          SamplingParams, Scheduler)
+from paddle_tpu.inference.serving.scheduler import (ABORTED, EXPIRED,
+                                                    EXPORTED, Request,
+                                                    WAITING)
+from paddle_tpu.monitor import chaos, flight
+from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+N_TOKENS = 6
+PROMPT_LENS = (3, 9, 5, 12, 7, 4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, ffn_hidden=128, max_seq_len=64,
+                    dropout=0.0, use_flash_attention=False,
+                    initializer_range=0.35)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(3)
+    return [list(rng.randint(1, 128, n)) for n in PROMPT_LENS]
+
+
+@pytest.fixture(scope="module")
+def want(model, prompts):
+    """Fault-free single-replica reference the resilience paths must
+    reproduce token-for-token."""
+    eng = LLMEngine(model, max_batch=4, block_size=8, num_blocks=32)
+    outs = eng.generate(prompts,
+                        sampling=SamplingParams(max_new_tokens=N_TOKENS))
+    assert eng.check_drained() == {}
+    return outs
+
+
+def sp(**kw):
+    kw.setdefault("max_new_tokens", N_TOKENS)
+    return SamplingParams(**kw)
+
+
+def assert_no_leaks(obj):
+    """check_drained() + the PTA070 report view, both clean."""
+    from paddle_tpu.analysis.serving import audit_block_accounting
+
+    assert obj.check_drained() == {}
+    engines = ([r.engine for r in obj._replicas]
+               if isinstance(obj, Router) else [obj])
+    for eng in engines:
+        live = [r.req_id for r in eng._requests.values()
+                if not r.finished]
+        rep = audit_block_accounting(eng.cache.allocator, live)
+        assert rep.findings == [], [f.format() for f in rep.findings]
+
+
+# ---------------------------------------------------------------------------
+# ring (a): deadlines + shedding + victim policy
+# ---------------------------------------------------------------------------
+
+class TestDeadlines:
+    def test_expiry_racing_admission(self, model, prompts):
+        """A request whose deadline passes between add and the next
+        admission pass retires EXPIRED at admission — before it takes
+        any pool blocks or prefill compute. Zero leaks."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        rid = eng.add_request(prompts[0], sp(deadline_s=0.005))
+        time.sleep(0.02)
+        before = cmon.stat_get("serve/deadline_aborts")
+        eng.step()
+        req = eng.get_request(rid)
+        assert req.state == EXPIRED and req.finished
+        assert req.output_ids == []
+        assert cmon.stat_get("serve/deadline_aborts") == before + 1
+        assert_no_leaks(eng)
+
+    def test_expiry_while_queued_behind_full_batch(self, model,
+                                                   prompts):
+        """Deadline passes while WAITING behind a full batch: the
+        later admission pass (slots free as requests finish) sweeps
+        it instead of serving a dead-on-arrival request; live
+        requests are untouched."""
+        eng = LLMEngine(model, max_batch=1, block_size=8,
+                        num_blocks=32)
+        slow = eng.add_request(prompts[0], sp())
+        doomed = eng.add_request(prompts[1], sp(deadline_s=0.01))
+        live = eng.add_request(prompts[2], sp())
+        time.sleep(0.03)
+        while eng.has_unfinished():
+            eng.step()
+        from paddle_tpu.inference.serving.scheduler import FINISHED
+        assert eng.get_request(doomed).state == EXPIRED
+        assert eng.get_request(slow).state == FINISHED
+        assert eng.get_request(live).state == FINISHED
+        assert len(eng.get_request(live).output_ids) == N_TOKENS
+        assert_no_leaks(eng)
+
+    def test_running_requests_are_never_deadline_killed(self, model,
+                                                        prompts):
+        """A RUNNING request past its deadline finishes: it already
+        paid prefill, completing is the cheaper path (the policy the
+        scheduler documents)."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        rid = eng.add_request(prompts[0], sp(deadline_s=0.05))
+        eng.step()                   # admitted before expiry
+        time.sleep(0.08)             # expires while RUNNING
+        while eng.has_unfinished():
+            eng.step()
+        assert len(eng.get_request(rid).output_ids) == N_TOKENS
+        assert_no_leaks(eng)
+
+
+class TestLoadShedding:
+    def test_queue_bound_sheds(self, model, prompts):
+        eng = LLMEngine(model, max_batch=1, block_size=8,
+                        num_blocks=32, max_queue=2)
+        eng.add_request(prompts[0], sp())
+        eng.step()                   # 1 running, queue empty
+        eng.add_request(prompts[1], sp())
+        eng.add_request(prompts[2], sp())
+        before = cmon.stat_get("serve/shed")
+        with pytest.raises(EngineOverloaded, match="load shed"):
+            eng.add_request(prompts[3], sp())
+        assert cmon.stat_get("serve/shed") == before + 1
+        while eng.has_unfinished():
+            eng.step()
+        assert_no_leaks(eng)
+
+    def test_expired_corpses_swept_before_shedding(self, model,
+                                                   prompts):
+        """A queue full of already-expired entries must not shed live
+        traffic: the bound check sweeps expired requests first."""
+        eng = LLMEngine(model, max_batch=1, block_size=8,
+                        num_blocks=32, max_queue=2)
+        eng.add_request(prompts[0], sp())
+        eng.step()
+        d1 = eng.add_request(prompts[1], sp(deadline_s=0.005))
+        d2 = eng.add_request(prompts[2], sp(deadline_s=0.005))
+        time.sleep(0.02)
+        live = eng.add_request(prompts[3], sp())   # sweeps, no shed
+        assert eng.get_request(d1).state == EXPIRED
+        assert eng.get_request(d2).state == EXPIRED
+        while eng.has_unfinished():
+            eng.step()
+        assert len(eng.get_request(live).output_ids) == N_TOKENS
+        assert_no_leaks(eng)
+
+    def test_env_max_queue(self, monkeypatch):
+        from paddle_tpu.inference.serving import env_max_queue
+
+        monkeypatch.setenv("PADDLE_SERVE_MAX_QUEUE", "7")
+        assert env_max_queue() == 7
+        monkeypatch.setenv("PADDLE_SERVE_MAX_QUEUE", "bogus")
+        assert env_max_queue() == 0
+
+
+class TestVictimPolicy:
+    def _sched(self):
+        cache = PagedKVCache(2, 4, 16, block_size=4, num_blocks=64)
+        return Scheduler(cache, max_batch=4, max_seq_len=64)
+
+    def test_low_priority_evicts_first(self):
+        s = self._sched()
+        lo = Request([1] * 4, sp(priority=-1))
+        hi = Request([1] * 4, sp(priority=5))
+        mid = Request([1] * 4, sp())
+        for r in (hi, lo, mid):     # admission order != priority
+            s.add(r)
+        s.schedule()
+        assert s._pick_victim() is lo
+        s.evict(lo)
+        assert s._pick_victim() is mid     # 0 < 5
+
+    def test_latest_deadline_loses_tiebreak(self):
+        s = self._sched()
+        tight = Request([1] * 4, sp(deadline_s=0.5))
+        slack = Request([1] * 4, sp(deadline_s=50.0))
+        none = Request([1] * 4, sp())      # no SLO = most slack
+        for r in (none, slack, tight):
+            s.add(r)
+        s.schedule()
+        assert s._pick_victim() is none
+        s.evict(none)
+        assert s._pick_victim() is slack
+
+    def test_default_policy_stays_youngest_first(self):
+        """No priorities/deadlines -> the PR-10 vLLM youngest-first
+        policy is unchanged."""
+        s = self._sched()
+        old = Request([1] * 4, sp())
+        young = Request([1] * 4, sp())
+        s.add(old), s.add(young)
+        s.schedule()
+        assert s._pick_victim() is young
+
+
+# ---------------------------------------------------------------------------
+# ring (b): lifecycle — drain / export / timeout / incident hook
+# ---------------------------------------------------------------------------
+
+class TestDrain:
+    def test_drain_completes_running_exports_waiting(self, model,
+                                                     prompts, want):
+        """drain(): RUNNING requests finish, WAITING export; imports
+        on a second engine continue token-identically."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        ids = [eng.add_request(p, sp()) for p in prompts[:4]]
+        eng.step()                  # 2 running, 2 waiting
+        before = cmon.stat_get("serve/drains")
+        exports = eng.drain()
+        assert cmon.stat_get("serve/drains") == before + 1
+        assert [e["req_id"] for e in exports] == ids[2:]
+        assert_no_leaks(eng)
+        # the two RUNNING requests completed in full
+        for i in ids[:2]:
+            assert len(eng.get_request(i).output_ids) == N_TOKENS
+        # a draining engine sheds new intake
+        with pytest.raises(EngineOverloaded, match="draining"):
+            eng.add_request(prompts[0], sp())
+        # imports replay token-exactly elsewhere
+        eng2 = LLMEngine(model, max_batch=2, block_size=8,
+                         num_blocks=32)
+        for e in exports:
+            eng2.import_request(e)
+        while eng2.has_unfinished():
+            eng2.step()
+        got = [eng.get_request(i).output_ids for i in ids[:2]] + \
+            [eng2.get_request(i).output_ids for i in ids[2:]]
+        assert got == want[:4]
+        assert_no_leaks(eng2)
+
+    def test_drain_timeout_exports_running_mid_generation(
+            self, model, prompts, want):
+        """A drain timeout exports still-RUNNING requests with their
+        generated-so-far prefix; replay completes the exact fault-free
+        tokens (position-keyed seeds make any prefix resumable)."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        ids = [eng.add_request(p,
+                               sp(max_new_tokens=N_TOKENS))
+               for p in prompts[:2]]
+        eng.step()                  # prefill: 1 token each
+        exports = eng.drain(timeout_s=0)
+        assert [e["req_id"] for e in exports] == ids
+        assert all(len(e["output_ids"]) >= 1 for e in exports)
+        assert_no_leaks(eng)
+        eng2 = LLMEngine(model, max_batch=2, block_size=8,
+                         num_blocks=32)
+        for e in exports:
+            eng2.import_request(e)
+        while eng2.has_unfinished():
+            eng2.step()
+        assert [eng2.get_request(i).output_ids
+                for i in ids] == want[:2]
+        assert_no_leaks(eng2)
+
+    def test_resume_reopens_admission(self, model, prompts, want):
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        eng.drain()
+        eng.resume()
+        outs = eng.generate(prompts[:2], sampling=sp())
+        assert outs == want[:2]
+        assert_no_leaks(eng)
+
+    def test_drain_chaos_raise_leaves_engine_intact(self, model,
+                                                    prompts, want):
+        """A serve_drain chaos raise aborts the drain BEFORE any
+        request is exported: the engine keeps serving, nothing
+        leaks, and the retry drains normally."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        rid = eng.add_request(prompts[0], sp())
+        with chaos.inject("serve_drain", "raise", times=1) as rule:
+            with pytest.raises(chaos.ChaosInjected):
+                eng.drain()
+            assert rule.triggers == 1
+        # the aborted drain latched nothing: admission reopens after
+        # clearing the half-set draining flag via resume()
+        eng.resume()
+        while eng.has_unfinished():
+            eng.step()
+        assert eng.get_request(rid).output_ids == want[0]
+        exports = eng.drain()       # retry succeeds
+        assert exports == []
+        assert_no_leaks(eng)
+
+
+class TestDrainFenceInterplay:
+    def test_drain_returns_emergency_exports_after_mid_drain_fence(
+            self, model, prompts, want):
+        """If the watchdog incident hook fences the engine mid-drain,
+        drain() must fold emergency_exports into its return — [] here
+        would read as 'all completed' and the caller would drop the
+        in-flight work (the PTA073 class)."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        ids = [eng.add_request(p, sp()) for p in prompts[:2]]
+        eng.step()
+        # simulate the hook firing between drain's dispatches
+        eng._incident_export("watchdog")
+        exports = eng.drain(timeout_s=1)
+        assert [e["req_id"] for e in exports] == ids
+        assert eng.emergency_exports is None
+        assert_no_leaks(eng)
+        eng2 = LLMEngine(model, max_batch=2, block_size=8,
+                         num_blocks=32)
+        for e in exports:
+            eng2.import_request(e)
+        while eng2.has_unfinished():
+            eng2.step()
+        assert [eng2.get_request(i).output_ids
+                for i in ids] == want[:2]
+
+    def test_fenced_engine_refuses_intake(self, model, prompts):
+        """A fenced engine never steps again — add_request and even
+        forced import_request must refuse instead of queueing work
+        that strands forever."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        rid = eng.add_request(prompts[0], sp())
+        eng.step()
+        exports = eng.export_requests(fence=True)
+        assert [e["req_id"] for e in exports] == [rid]
+        with pytest.raises(EngineOverloaded, match="fenced"):
+            eng.add_request(prompts[1], sp())
+        with pytest.raises(EngineOverloaded, match="fenced"):
+            eng.import_request(exports[0], force=True)
+        assert_no_leaks(eng)
+
+    def test_router_abort_backs_off_when_step_lock_held(self, model,
+                                                        prompts):
+        """abort() must not mutate the scheduler unlocked while the
+        worker holds the step lock (freed blocks under an in-flight
+        dispatch): it raises the retryable EngineOverloaded
+        instead."""
+        router = Router(model, replicas=1, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            rid = router.submit(prompts[0], sp(max_new_tokens=48))
+            rep = router._replicas[0]
+            assert rep.step_lock.acquire(timeout=10)
+            try:
+                with pytest.raises(EngineOverloaded, match="busy"):
+                    router.abort(rid)
+            finally:
+                rep.step_lock.release()
+            # the documented contract: back off and retry (the hot
+            # worker loop re-takes the lock between steps, so one
+            # attempt may lose the race repeatedly)
+            deadline = time.monotonic() + 30
+            while not router.get_request(rid).finished:
+                try:
+                    router.abort(rid)
+                except EngineOverloaded:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+            assert router.get_request(rid).finished
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+
+class TestGenerateTimeout:
+    def test_timeout_raises_with_engine_state(self, model, prompts):
+        eng = LLMEngine(model, max_batch=1, block_size=8,
+                        num_blocks=32)
+        with pytest.raises(EngineTimeout) as ei:
+            eng.generate(prompts[:3],
+                         sampling=sp(max_new_tokens=48),
+                         timeout_s=1e-4)
+        state = ei.value.engine_state
+        assert state["running"] + state["waiting"] >= 1
+        assert "heartbeat_age_s" in state and "free_blocks" in state
+        # abandoned work is still abortable and leak-free
+        for r in list(eng._requests.values()):
+            if not r.finished:
+                eng.abort_request(r.req_id)
+        assert_no_leaks(eng)
+
+    def test_no_timeout_by_default(self, model, prompts, want):
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        assert eng.generate(prompts[:2], sampling=sp()) == want[:2]
+
+
+class TestIncidentExport:
+    def test_watchdog_hook_exports_and_fences(self, model, prompts,
+                                              want):
+        """The PR-3/6 incident hook path: a watchdog dump on a wedged
+        dispatch fences the engine and exports its in-flight work —
+        replayable on a healthy engine, token-exactly."""
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32).arm_incident_export()
+        try:
+            ids = [eng.add_request(p, sp()) for p in prompts[:2]]
+            eng.step()
+            flight._run_incident_hooks("watchdog")
+            assert eng.fenced
+            assert eng.step() == {}          # zombie steps no-op
+            exports = eng.emergency_exports
+            assert [e["req_id"] for e in exports] == ids
+            assert_no_leaks(eng)             # exports released blocks
+            eng2 = LLMEngine(model, max_batch=2, block_size=8,
+                             num_blocks=32)
+            for e in exports:
+                eng2.import_request(e)
+            while eng2.has_unfinished():
+                eng2.step()
+            assert [eng2.get_request(i).output_ids
+                    for i in ids] == want[:2]
+            assert_no_leaks(eng2)
+        finally:
+            eng.disarm_incident_export()
+
+    def test_idle_engine_hook_is_a_noop(self, model):
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32).arm_incident_export()
+        try:
+            flight._run_incident_hooks("watchdog")
+            assert not eng.fenced
+            assert eng.emergency_exports is None
+        finally:
+            eng.disarm_incident_export()
+
+
+# ---------------------------------------------------------------------------
+# ring (c): the multi-replica router
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def test_clean_two_replica_run_matches_reference(self, model,
+                                                     prompts, want):
+        router = Router(model, replicas=2, max_batch=4, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            outs = router.generate(prompts, sampling=sp(),
+                                   timeout_s=120)
+            assert outs == want
+            assert_no_leaks(router)
+            assert all(router.replica_healthy(i) for i in range(2))
+        finally:
+            router.shutdown()
+
+    def test_least_loaded_routing_by_free_blocks(self, model,
+                                                 prompts):
+        router = Router(model, replicas=2, max_batch=4, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            a = router.submit(prompts[3], sp())   # 12 tokens
+            b = router.submit(prompts[0], sp())   # 3 tokens
+            ra = router._records[a].replica
+            rb = router._records[b].replica
+            assert ra != rb     # second lands on the emptier replica
+            router.wait([a, b], timeout_s=120)
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_serve_route_fault_sheds_cleanly(self, model, prompts):
+        """A raising serve_route fault fails the submit BEFORE any
+        replica is touched: no record, no blocks, retry routes."""
+        router = Router(model, replicas=2, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            with chaos.inject("serve_route", "raise",
+                              times=1) as rule:
+                with pytest.raises(chaos.ChaosInjected):
+                    router.submit(prompts[0], sp())
+                assert rule.triggers == 1
+            assert router._records == {}
+            assert_no_leaks(router)
+            rid = router.submit(prompts[0], sp())   # retry clean
+            router.wait([rid], timeout_s=120)
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_e2e_failover_gate_replica_crash_mid_flood(
+            self, model, prompts, want):
+        """THE acceptance gate: 2 replicas, a chaos-injected replica
+        kill mid-flood — every request completes with tokens
+        identical to the fault-free single-replica run, zero leaked
+        KV blocks on all replicas, and serve/failovers > 0 in the
+        telemetry snapshot."""
+        from paddle_tpu import monitor as pmonitor
+
+        router = Router(model, replicas=2, max_batch=4, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            before = cmon.stat_get("serve/failovers")
+            # a non-OOM decode fault kills the dispatching replica's
+            # worker thread mid-flood (after= lets the flood spread
+            # over both replicas first)
+            with chaos.inject("serve_decode", "raise", after=3,
+                              times=1) as rule:
+                outs = router.generate(prompts, sampling=sp(),
+                                       timeout_s=120)
+                assert rule.triggers == 1
+            assert outs == want
+            snap = pmonitor.telemetry_snapshot()["stats"]
+            assert snap["serve/failovers"] >= before + 1
+            assert_no_leaks(router)      # dead replica included
+            healthy = [i for i in range(2)
+                       if router.replica_healthy(i)]
+            assert len(healthy) == 1
+            gauges = [cmon.stat_get(f"serve/replica/{i}/healthy")
+                      for i in range(2)]
+            assert sorted(gauges) == [0, 1]
+            # the survivor keeps serving
+            more = router.generate(prompts[:2], sampling=sp(),
+                                   timeout_s=120)
+            assert more == want[:2]
+        finally:
+            router.shutdown()
+
+    def test_failover_preserves_seeded_sampling(self, model,
+                                                prompts):
+        """Token identity under failover holds for SEEDED temperature
+        sampling too — the position-keyed seeds, not greedy argmax,
+        carry the determinism."""
+        sampling = sp(temperature=0.8, top_k=20, seed=11)
+        ref = LLMEngine(model, max_batch=4, block_size=8,
+                        num_blocks=32)
+        want_s = ref.generate(prompts[:4], sampling=sampling)
+        router = Router(model, replicas=2, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            with chaos.inject("serve_decode", "raise", after=2,
+                              times=1):
+                outs = router.generate(prompts[:4], sampling=sampling,
+                                       timeout_s=120)
+            assert outs == want_s
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    @pytest.mark.slow
+    def test_wedge_failover_via_heartbeat(self, model, prompts,
+                                          want):
+        """A replica wedged INSIDE a dispatch (chaos stall) stops
+        stamping heartbeats; the router declares it dead after
+        heartbeat_timeout_s and replays its requests — the zombie
+        thread waking later no-ops against the fence."""
+        router = Router(model, replicas=2, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=5.0)
+        try:
+            # warm both replicas' compiled programs first so a
+            # first-dispatch XLA compile can't read as a wedge
+            assert router.generate(prompts, sampling=sp(),
+                                   timeout_s=120) == want
+            before = cmon.stat_get("serve/failovers")
+            with chaos.inject("serve_decode", "stall", secs=300,
+                              after=2, times=1):
+                outs = router.generate(prompts, sampling=sp(),
+                                       timeout_s=120)
+            assert outs == want
+            assert cmon.stat_get("serve/failovers") == before + 1
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_heartbeat_never_retires_last_replica(self, model,
+                                                  prompts, want):
+        """The cascade backstop: a stale heartbeat on the LAST
+        healthy replica (e.g. a slow first-bucket compile after
+        absorbing a failover) must NOT retire it — the slow-but-alive
+        replica finishes instead of the fleet dying."""
+        router = Router(model, replicas=1, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=0.2)
+        try:
+            before = cmon.stat_get("serve/failovers")
+            with chaos.inject("serve_decode", "stall", secs=1.0,
+                              after=1, times=1):
+                outs = router.generate(prompts[:2], sampling=sp(),
+                                       timeout_s=120)
+            assert outs == want[:2]
+            assert cmon.stat_get("serve/failovers") == before
+            assert router.replica_healthy(0)
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_shed_then_retry_on_drained_router(self, model, prompts,
+                                               want):
+        """Drain the fleet -> submits shed (EngineOverloaded with
+        router state attached) -> resume -> the retry serves. Zero
+        leaks throughout."""
+        router = Router(model, replicas=2, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            rid = router.submit(prompts[0], sp())
+            # wait for the worker to admit + prefill before draining,
+            # so the request is RUNNING (drain completes it) rather
+            # than still WAITING (drain would export it)
+            t0 = time.monotonic()
+            while not router.get_request(rid).output_ids \
+                    and time.monotonic() - t0 < 60:
+                time.sleep(0.005)
+            exports = router.drain(timeout_s=60)
+            # the running request completed inside the drain window
+            assert exports == []
+            assert router.get_request(rid).output_ids == want[0]
+            before = cmon.stat_get("serve/shed")
+            with pytest.raises(EngineOverloaded) as ei:
+                router.submit(prompts[1], sp())
+            # every healthy replica shed once before the router gave up
+            assert cmon.stat_get("serve/shed") == before + 2
+            assert ei.value.engine_state["healthy"] == 2
+            assert_no_leaks(router)
+            router.resume()
+            outs = router.generate(prompts[:2], sampling=sp(),
+                                   timeout_s=120)
+            assert outs == want[:2]
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_all_replicas_dead_retains_orphans(self, model, prompts):
+        """When the LAST replica dies the un-replayable exports are
+        retained in orphan_exports (never silently dropped — the
+        PTA073 contract) and wait() raises."""
+        router = Router(model, replicas=1, max_batch=2, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0)
+        try:
+            ids = [router.submit(p, sp(max_new_tokens=24))
+                   for p in prompts[:2]]
+            with chaos.inject("serve_decode", "raise", after=1,
+                              times=1):
+                with pytest.raises(RuntimeError,
+                                   match="no healthy"):
+                    router.wait(ids, timeout_s=60)
+            assert len(router.orphan_exports) == 2
+            assert {e["req_id"] for e in router.orphan_exports} == \
+                set(ids)
+            assert_no_leaks(router)  # exports released their blocks
+        finally:
+            router.shutdown()
+
+    def test_env_knobs(self, monkeypatch):
+        from paddle_tpu.inference.serving import (env_heartbeat_s,
+                                                  env_replicas)
+
+        monkeypatch.setenv("PADDLE_SERVE_REPLICAS", "3")
+        monkeypatch.setenv("PADDLE_SERVE_HEARTBEAT_S", "2.5")
+        assert env_replicas() == 3
+        assert env_heartbeat_s() == 2.5
+        monkeypatch.setenv("PADDLE_SERVE_REPLICAS", "junk")
+        monkeypatch.setenv("PADDLE_SERVE_HEARTBEAT_S", "junk")
+        assert env_replicas() == 1
+        assert env_heartbeat_s() == 10.0
+
+
+# ---------------------------------------------------------------------------
+# chaos sites + PTA073
+# ---------------------------------------------------------------------------
+
+class TestChaosSites:
+    def test_new_sites_registered(self):
+        assert "serve_route" in chaos.SITES
+        assert "serve_drain" in chaos.SITES
+
+    def test_sites_listed_by_cli_surface(self):
+        # the chaos spec grammar accepts the new sites
+        rules = chaos.parse_spec(
+            "serve_route:raise;serve_drain:delay:ms=1")
+        assert [r.site for r in rules] == ["serve_route",
+                                           "serve_drain"]
+
+
+class TestPTA073:
+    def test_discarded_export_flagged(self):
+        from paddle_tpu.analysis.serving import lint_kv_source
+
+        src = ("def failover(self, rep):\n"
+               "    rep.engine.export_requests(fence=True)\n")
+        rep = lint_kv_source(src, filename="x.py")
+        assert [f.code for f in rep.findings] == ["PTA073"]
+
+    def test_assigned_but_never_read_flagged(self):
+        from paddle_tpu.analysis.serving import lint_kv_source
+
+        src = ("def failover(self, rep):\n"
+               "    exports = rep.engine.export_requests()\n"
+               "    rep.dead = True\n")
+        rep = lint_kv_source(src, filename="x.py")
+        assert [f.code for f in rep.findings] == ["PTA073"]
+
+    def test_readded_or_returned_exports_clean(self):
+        from paddle_tpu.analysis.serving import lint_kv_source
+
+        good_readd = ("def failover(self, rep, target):\n"
+                      "    exports = rep.engine.export_requests()\n"
+                      "    for e in exports:\n"
+                      "        target.import_request(e)\n")
+        good_return = ("def drain(self):\n"
+                       "    exports = self.export_requests()\n"
+                       "    return exports\n")
+        for src in (good_readd, good_return):
+            assert lint_kv_source(src, filename="x.py").findings == []
+
+    def test_router_and_engine_sources_clean(self):
+        """The failover/drain implementations satisfy their own
+        lint — every export path re-adds, returns, or retains."""
+        import os
+
+        from paddle_tpu.analysis.cli import iter_target_files, \
+            lint_file
+        from paddle_tpu.analysis.diagnostics import Report
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        rep = Report()
+        target = os.path.join(repo, "paddle_tpu", "inference",
+                              "serving")
+        for path in iter_target_files(target):
+            lint_file(path, rep, sanitize=("serving",))
+        assert not rep.findings, [f.format() for f in rep.findings]
+
+
+class TestStateTransitions:
+    def test_exported_and_expired_are_terminal(self):
+        r = Request([1, 2], sp())
+        for state in (EXPIRED, EXPORTED, ABORTED):
+            r.state = state
+            assert r.finished
+        r.state = WAITING
+        assert not r.finished
+
+    def test_import_preserves_deadline_and_evictions(self, model,
+                                                     prompts):
+        eng = LLMEngine(model, max_batch=2, block_size=8,
+                        num_blocks=32)
+        rid = eng.add_request(prompts[0], sp(deadline_s=30.0))
+        eng.step()
+        req = eng.get_request(rid)
+        req.evictions = 2
+        deadline = req.deadline
+        exports = eng.export_requests()
+        eng2 = LLMEngine(model, max_batch=2, block_size=8,
+                         num_blocks=32)
+        eng2.import_request(exports[0])
+        r2 = eng2.get_request(rid)
+        assert r2.deadline == deadline     # absolute SLO survives
+        assert r2.evictions == 2
+        assert r2.output_ids == req.output_ids
+        assert_no_leaks(eng2.scheduler and eng2)
