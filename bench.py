@@ -771,6 +771,80 @@ def bench_linalg(on_tpu):
         dla.clear_program_cache()
 
 
+def bench_qcomm(on_tpu):
+    """ISSUE 14: the quantized-collective twin — the SAME dp training
+    run through the explicit fp32 allreduce island and the int8
+    error-feedback one (distributed.compress). Records the measured
+    wire-bytes ratio (comm/all_reduce/wire_bytes deltas — the
+    compression is priced, not asserted), the step-time delta (on
+    the CPU smoke the quantize arithmetic usually COSTS time; the
+    wire win needs real ICI), and the final-loss delta (the quality
+    tax). Embedded as extra.qcomm by main()."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.core import monitor as _cmon
+    from paddle_tpu.distributed import build_mesh, get_mesh, set_mesh
+    from paddle_tpu.jit.distributed import DistributedTrainStepCompiler
+
+    n_dev = len(jax.devices())
+    steps = 24 if on_tpu else 12
+    hidden = 2048 if on_tpu else 256
+    prev = get_mesh()
+    keys = ("comm/all_reduce/bytes", "comm/all_reduce/wire_bytes")
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(2 * n_dev, 64).astype(np.float32)
+          for _ in range(steps)]
+    ys = [rng.randn(2 * n_dev, 8).astype(np.float32)
+          for _ in range(steps)]
+
+    def run(spec):
+        paddle.seed(0)
+        mesh = build_mesh({"dp": n_dev})
+        set_mesh(mesh)
+        model = nn.Sequential(nn.Linear(64, hidden), nn.ReLU(),
+                              nn.Linear(hidden, 8))
+        opt = optim.AdamW(learning_rate=1e-2,
+                          parameters=model.parameters())
+        step = DistributedTrainStepCompiler(
+            model, opt, loss_fn=lambda o, t: ((o - t) ** 2).mean(),
+            mesh=mesh, comm_compress=spec)
+        c0 = {k: _cmon.stat_get(k) for k in keys}
+        loss = step(paddle.to_tensor(xs[0]),
+                    paddle.to_tensor(ys[0]))  # compile + step 0
+        losses = [float(loss.item())]
+        t0 = time.perf_counter()
+        for x, y in zip(xs[1:], ys[1:]):
+            loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        losses.append(float(loss.item()))
+        dt = (time.perf_counter() - t0) / (steps - 1)
+        return {"first_loss": round(losses[0], 6),
+                "final_loss": round(losses[-1], 6),
+                "step_ms": round(dt * 1e3, 3),
+                "comm": {k: _cmon.stat_get(k) - c0[k] for k in keys}}
+
+    try:
+        fp32 = run("fp32")
+        int8 = run("int8:ef")
+        ratio = (int8["comm"]["comm/all_reduce/wire_bytes"]
+                 / max(fp32["comm"]["comm/all_reduce/wire_bytes"], 1))
+        r = _pack(round(ratio, 4), "wire_bytes_ratio",
+                  [int8["step_ms"] / 1e3])
+        r["devices"] = n_dev
+        r["fp32"] = fp32
+        r["int8_ef"] = int8
+        r["step_time_delta_ms"] = round(
+            int8["step_ms"] - fp32["step_ms"], 3)
+        r["final_loss_delta"] = round(
+            abs(int8["final_loss"] - fp32["final_loss"]), 6)
+        return r
+    finally:
+        set_mesh(prev)
+
+
 def main():
     import jax
 
@@ -784,6 +858,7 @@ def main():
         "ernie": bench_ernie,
         "serving": bench_serving,
         "linalg": bench_linalg,
+        "qcomm": bench_qcomm,
     }
     results = {}
     for name, fn in suite.items():
@@ -896,7 +971,8 @@ def main():
                 k: v for k, v in stats.items()
                 if k.startswith(("sanitize/", "analysis/PTA04",
                                  "analysis/PTA05", "analysis/PTA06",
-                                 "analysis/PTA07"))}}
+                                 "analysis/PTA07",
+                                 "analysis/PTA08"))}}
         # serving-engine attribution (ISSUE 11): request/token
         # volumes, prefill vs decode wall time, KV-pool occupancy
         # and the eviction counts behind the serving config's

@@ -49,6 +49,9 @@ from .sharding import (check_batch_specs, check_replicated_params,
 # serving KV-block accounting (ISSUE 11): PTA07x static half
 from . import serving
 from .serving import audit_block_accounting, lint_kv_source
+# quantized-collective sanitizer (ISSUE 14): PTA08x
+from . import compress
+from .compress import lint_compress_source
 
 __all__ = [
     "DIAGNOSTICS", "Finding", "Report", "Severity", "check",
@@ -62,6 +65,7 @@ __all__ = [
     "lint_locks_source", "lint_sharding_source", "check_spec",
     "check_batch_specs", "check_replicated_params",
     "lint_kv_source", "audit_block_accounting",
+    "compress", "lint_compress_source",
 ]
 
 

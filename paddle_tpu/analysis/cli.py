@@ -53,10 +53,12 @@ def iter_target_files(target):
 # the AST halves of the sanitizer suite (runtime halves arm via
 # PADDLE_SANITIZE); import lazily so the bare preflight CLI stays
 # light.
-SANITIZE_FAMILIES = ("donation", "locks", "sharding", "serving")
+SANITIZE_FAMILIES = ("donation", "locks", "sharding", "serving",
+                     "compress")
 
 
 def _sanitize_passes(families):
+    from .compress import lint_compress_source
     from .concurrency import lint_locks_source
     from .donation import lint_donation_source
     from .serving import lint_kv_source
@@ -65,7 +67,8 @@ def _sanitize_passes(families):
     table = {"donation": lint_donation_source,
              "locks": lint_locks_source,
              "sharding": lint_sharding_source,
-             "serving": lint_kv_source}
+             "serving": lint_kv_source,
+             "compress": lint_compress_source}
     return [table[f] for f in families]
 
 
@@ -107,9 +110,9 @@ def main(argv=None):
                     metavar="FAMILIES",
                     help="also run the sanitizer static passes "
                          "(PTA04x donation, PTA05x sharding, PTA06x "
-                         "locks, PTA07x serving); optional comma "
-                         "list donation,locks,sharding,serving "
-                         "(default: all)")
+                         "locks, PTA07x serving, PTA08x compress); "
+                         "optional comma list donation,locks,"
+                         "sharding,serving,compress (default: all)")
     args = ap.parse_args(argv)
 
     sanitize = ()

@@ -1,0 +1,212 @@
+"""PTA08x quantized-collective sanitizer (ISSUE 14).
+
+Static half (the CLI `--sanitize compress` leg), over source:
+
+  * an error-feedback allreduce call (`all_reduce_flat(...,
+    residual=...)` / `reduce_tree(..., residual=...)`) whose result
+    is DISCARDED — a bare statement, or bound to a name never read
+    again in the function. The new residual is the whole point of
+    error feedback: dropping it silently degrades every later step
+    back to biased quantization                          (PTA080)
+  * `all_reduce(..., op=ReduceOp.<not SUM/AVG>, compress=...)` — a
+    literal non-SUM reduce asked to ride the quantized wire;
+    blockwise abs-max scales only commute with summation (PTA081)
+
+Runtime half (armed by `PADDLE_SANITIZE=compress`, report-only under
+`PADDLE_ANALYSIS=1`): `guard_residual_donated` at the compressed
+train-step build (a residual outside the donated carry churns a full
+gradient copy per dispatch — the PTA080 class at runtime) and
+`guard_quantizable` at every compress-requesting all_reduce (PTA081:
+non-SUM op or integer dtype). Under the sanitizer the error findings
+RAISE; under analysis they report; disarmed they fall back silently
+(counter-clean, the bench provenance contract).
+"""
+from __future__ import annotations
+
+import ast
+
+from .diagnostics import Report, Severity
+from .preflight import _walk_no_nested_defs
+
+__all__ = ["lint_compress_source", "guard_residual_donated",
+           "guard_quantizable"]
+
+_EF_CALL_NAMES = ("all_reduce_flat", "reduce_tree")
+_SUM_OPS = ("SUM", "AVG")
+
+
+def _call_attr(node):
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+    return ""
+
+
+def _has_residual_kwarg(call):
+    return any(kw.arg == "residual" and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is None)
+        for kw in call.keywords)
+
+
+def _nonsum_op_kwargs(call):
+    """The (op=, compress=) keyword pair when op is a literal
+    ReduceOp.<X> with X outside SUM/AVG and compress is not
+    None/False."""
+    op_name, compressed = None, False
+    for kw in call.keywords:
+        if kw.arg == "op" and isinstance(kw.value, ast.Attribute):
+            op_name = kw.value.attr
+        if kw.arg == "compress":
+            v = kw.value
+            compressed = not (isinstance(v, ast.Constant)
+                              and v.value in (None, False))
+    if compressed and op_name is not None and op_name not in _SUM_OPS:
+        return op_name
+    return None
+
+
+def lint_compress_source(source, filename="<string>", report=None):
+    """AST pass over one file: dropped error-feedback residuals
+    (PTA080) and literal non-SUM quantized allreduces (PTA081)."""
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return report
+
+    for node in ast.walk(tree):
+        # discarded EF-allreduce result — module/class level included
+        if isinstance(node, ast.Expr) and \
+                _call_attr(node.value) in _EF_CALL_NAMES and \
+                _has_residual_kwarg(node.value):
+            report.add(
+                "PTA080",
+                f"result of {_call_attr(node.value)}(..., "
+                "residual=...) is discarded — the updated "
+                "error-feedback residual is lost and every later "
+                "step re-feeds stale error",
+                file=filename, line=node.lineno,
+                severity=Severity.ERROR, analyzer="compress")
+        if isinstance(node, ast.Call) and \
+                _call_attr(node) == "all_reduce":
+            bad = _nonsum_op_kwargs(node)
+            if bad is not None:
+                report.add(
+                    "PTA081",
+                    f"all_reduce(op=ReduceOp.{bad}, compress=...): "
+                    "blockwise quantization only commutes with "
+                    "SUM/AVG — this op falls back to the fp32 wire",
+                    file=filename, line=node.lineno,
+                    severity=Severity.ERROR, analyzer="compress")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _lint_unread_residuals(node, report, filename)
+    return report
+
+
+def _lint_unread_residuals(fdef, report, filename):
+    """PTA080 second form: `out = reduce_tree(..., residual=r)` (or a
+    tuple unpack whose residual name) never read again — bound but
+    dead is dropped all the same."""
+    assigns = []  # (name, line, assign node)
+    for sub in _walk_no_nested_defs(fdef):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and _call_attr(sub.value) in _EF_CALL_NAMES
+                and _has_residual_kwarg(sub.value)):
+            continue
+        tgt = sub.targets[0]
+        if isinstance(tgt, ast.Name):
+            assigns.append((tgt.id, sub.lineno, sub))
+        elif isinstance(tgt, ast.Tuple) and tgt.elts and \
+                isinstance(tgt.elts[-1], ast.Name) and \
+                tgt.elts[-1].id != "_":
+            # (value, new_residual) — the residual is the last slot
+            assigns.append((tgt.elts[-1].id, sub.lineno, sub))
+    in_loop = set()
+    for sub in _walk_no_nested_defs(fdef):
+        if isinstance(sub, (ast.For, ast.AsyncFor, ast.While)):
+            in_loop.update(id(n) for n in ast.walk(sub))
+    for name, line, assign in assigns:
+        # a Load inside the assignment's own RHS (the straight-line
+        # self-update spelling `out, r = reduce_tree(...,
+        # residual=r)`) reads the OLD binding, not the new one — it
+        # must not count. INSIDE a loop the same read consumes the
+        # previous iteration's new binding (the canonical EF loop),
+        # so there it does count.
+        own_rhs = ({id(n) for n in ast.walk(assign.value)}
+                   if id(assign) not in in_loop else set())
+        reads = sum(
+            1 for sub in _walk_no_nested_defs(fdef)
+            if isinstance(sub, ast.Name) and sub.id == name
+            and isinstance(sub.ctx, ast.Load)
+            and id(sub) not in own_rhs)
+        if not reads:
+            report.add(
+                "PTA080",
+                f"{fdef.name}: error-feedback residual bound to "
+                f"{name!r} is never read — the updated residual is "
+                "dropped and feedback silently stops",
+                file=filename, line=line,
+                severity=Severity.ERROR, analyzer="compress")
+
+
+# ---------------------------------------------------------------------------
+# runtime half (gated like lint_spec: sanitize raises, analysis
+# reports, disarmed stays counter-clean)
+# ---------------------------------------------------------------------------
+
+def _emit_or_raise(code, msg):
+    from ..monitor import sanitize as _sanitize
+
+    armed = _sanitize._compress
+    if not armed:
+        from . import enabled as _analysis_enabled
+
+        if not _analysis_enabled():
+            return False
+    from ..monitor.sanitize import _emit
+
+    _emit(code, msg)
+    if armed:
+        raise ValueError(f"{code} {msg}")
+    return True
+
+
+def guard_residual_donated(donate, cfg, where="train_step"):
+    """PTA080 runtime check at the compressed train-step build: an
+    error-feedback residual OUTSIDE the donated carry means XLA
+    allocates a fresh full-gradient-sized buffer every dispatch and
+    the old one lingers until GC — the leak class this family
+    exists for. Raises under PADDLE_SANITIZE=compress, reports under
+    PADDLE_ANALYSIS=1, otherwise stays silent (the build still
+    works, just wastefully)."""
+    if cfg is None or not cfg.ef or donate:
+        return True
+    return not _emit_or_raise(
+        "PTA080",
+        f"{where}: comm_compress={cfg.spec()!r} with donate=False — "
+        "the error-feedback residual buffer is re-materialized every "
+        "dispatch instead of riding the donated carry")
+
+
+def guard_quantizable(op_is_sum, dtype_is_float, cfg,
+                      where="all_reduce"):
+    """PTA081 runtime check where a quantized allreduce is requested:
+    non-SUM/AVG reduce ops and integer payloads cannot ride blockwise
+    abs-max quantization. Returns True when the quantized path may
+    proceed; False means the caller must fall back to the
+    uncompressed wire (after raising under PADDLE_SANITIZE=compress
+    / reporting under PADDLE_ANALYSIS=1)."""
+    if cfg is None or cfg.mode == "fp32":
+        return True
+    if op_is_sum and dtype_is_float:
+        return True
+    why = ("non-SUM reduce op" if not op_is_sum
+           else "integer payload dtype")
+    _emit_or_raise(
+        "PTA081",
+        f"{where}: quantized allreduce ({cfg.spec()}) requested for "
+        f"a {why} — falling back to the uncompressed wire")
+    return False

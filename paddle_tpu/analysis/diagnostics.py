@@ -158,6 +158,20 @@ DIAGNOSTICS = {
                "never read — the failover/drain handoff drops them",
                "re-add every export (import_request), return it to "
                "the caller, or retain it (orphan_exports)"),
+    "PTA080": (Severity.ERROR,
+               "error-feedback residual leaked / never donated: the "
+               "quantized allreduce's residual state is dropped or "
+               "re-allocated per dispatch instead of riding the "
+               "donated carry — feedback is silently lost (or HBM "
+               "churns a full gradient copy per step)",
+               "keep the returned residual and thread it through "
+               "the donated train-step state (donate=True)"),
+    "PTA081": (Severity.ERROR,
+               "quantized allreduce requested for a non-SUM/AVG "
+               "reduce op or an integer dtype — blockwise abs-max "
+               "scales only commute with summation over floats",
+               "drop compress= for MAX/MIN/PROD and integer "
+               "tensors (the op falls back to the fp32 wire)"),
 }
 
 

@@ -22,6 +22,7 @@ TPU-native design, two execution regimes:
 from __future__ import annotations
 
 import functools
+import threading as _threading
 import time as _time
 
 import numpy as np
@@ -89,6 +90,29 @@ def _group_desc(group):
     return [int(r) for r in ranks] if ranks else "world"
 
 
+def _group_size(group):
+    """Participant count of a collective's group (mesh-axes product
+    for axis groups, rank-list length for explicit groups, world
+    otherwise) — the n in all_gather's n-tensor payload."""
+    try:
+        if group is not None:
+            return max(int(group.nranks), 1)
+        return max(int(world_group().nranks), 1)
+    except Exception:
+        return 1
+
+
+# wire-payload override for the in-flight collective: the quantized
+# all_reduce path knows its actual wire bytes (codes + scale
+# sidecars); every other op's wire payload IS its logical payload.
+# Thread-local: concurrent traces must not read each other's values.
+_wire_tls = _threading.local()
+
+
+def _set_wire_bytes(n):
+    _wire_tls.value = int(n)
+
+
 def _group_of(args, kwargs):
     """The group argument however it was passed — `group=` kwarg or
     positional (it sits at a different position per collective, so
@@ -117,23 +141,37 @@ def _instrumented(op):
     def deco(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            # payload = the `tensor` kwarg if given, else the first
-            # tensor-bearing positional arg (all_gather's first arg is
-            # the EMPTY output list — its payload is the second).
-            # Measured BEFORE the call: all_gather fills that output
-            # list, and measuring after would record world_size x the
-            # per-rank payload
-            candidates = []
-            if "tensor" in kwargs:
-                candidates.append(kwargs["tensor"])
-            candidates.extend(args[:2])
-            if "in_tensor_list" in kwargs:
-                candidates.append(kwargs["in_tensor_list"])
-            nbytes = 0
-            for a in candidates:
-                nbytes = _payload_bytes(a)
-                if nbytes:
-                    break
+            # Payload, measured BEFORE the call (all_gather fills its
+            # output list in place). List-arg collectives count the
+            # FULL payload, not one member's bytes: all_gather's
+            # result is group_size x the per-rank tensor (the old
+            # first-tensor count under-reported by n for every
+            # counter AND flight event), and scatter's payload is the
+            # whole tensor_list being distributed.
+            group = _group_of(args, kwargs)
+            if op == "all_gather":
+                base = kwargs.get("tensor")
+                if base is None and len(args) > 1:
+                    base = args[1]
+                nbytes = _payload_bytes(base) * _group_size(group)
+            elif op == "scatter":
+                tl = kwargs.get("tensor_list")
+                if tl is None and len(args) > 1:
+                    tl = args[1]
+                nbytes = (_payload_bytes(tl)
+                          or _payload_bytes(args[0] if args else None))
+            else:
+                candidates = []
+                if "tensor" in kwargs:
+                    candidates.append(kwargs["tensor"])
+                candidates.extend(args[:2])
+                if "in_tensor_list" in kwargs:
+                    candidates.append(kwargs["in_tensor_list"])
+                nbytes = 0
+                for a in candidates:
+                    nbytes = _payload_bytes(a)
+                    if nbytes:
+                        break
             # enabled-check out here: with the kill switch off
             # (PADDLE_FLIGHT_ENABLE=0) the comm hot path must not
             # even pay the group scan/label build
@@ -141,7 +179,8 @@ def _instrumented(op):
             if _flight.recorder.enabled:
                 tok = _flight.begin(
                     "collective", op, bytes=nbytes,
-                    group=_group_desc(_group_of(args, kwargs)))
+                    group=_group_desc(group))
+            _wire_tls.value = None  # compress path overrides below
             t0 = _time.perf_counter()
             try:
                 with _profiler.RecordEvent(f"comm/{op}",
@@ -165,6 +204,15 @@ def _instrumented(op):
                 int((_time.perf_counter() - t0) * 1e6))
             if nbytes:
                 _monitor.stat_add(f"comm/{op}/bytes", nbytes)
+                # wire payload: what actually crosses the links at
+                # this op's wire precision — equals the logical
+                # payload except on the quantized-allreduce path,
+                # which sets the override (codes + scale sidecars).
+                # comm/<op>/wire_bytes / comm/<op>/bytes is the
+                # measured compression ratio, not an asserted one
+                wire = getattr(_wire_tls, "value", None)
+                _monitor.stat_add(f"comm/{op}/wire_bytes",
+                                  wire if wire is not None else nbytes)
             return out
 
         return wrapped
@@ -289,10 +337,44 @@ def _reduce_in_trace(v, op, axes):
 
 
 @_instrumented("all_reduce")
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """c_allreduce_* analog (collective/c_allreduce_op.h:359)."""
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               compress=None):
+    """c_allreduce_* analog (collective/c_allreduce_op.h:359).
+
+    `compress` (per-call override of the quantized-collective wiring,
+    distributed.compress): a spec string ("int8"/"fp8"[:ef]/"fp32"),
+    a CompressConfig, or True for the $PADDLE_COMM_COMPRESS config —
+    the TRACED path then rides the blockwise-quantized allreduce
+    (wire accounting lands in comm/all_reduce/wire_bytes). Stateless:
+    no error-feedback residual here — EF lives in the train-step
+    wiring where the residual is donated state. Non-SUM/AVG ops and
+    integer dtypes report PTA081 and fall back to the fp32 wire (the
+    finding RAISES under PADDLE_SANITIZE=compress); multi-axis
+    groups and eager regimes fall back silently (a single
+    controller's allreduce is an identity — nothing to compress)."""
     axes = _axis_names(group)
     if _in_collective_trace(axes):
+        cfg = None
+        if compress is not None:
+            from . import compress as _compress_mod
+
+            cfg = _compress_mod.resolve(compress)
+        if cfg is not None and cfg.mode != "fp32" and len(axes) == 1 \
+                and _trace_axis_size(axes[0]) > 1:
+            # (a size-1 axis allreduce is an exact identity — the
+            # quantized round-trip would only inject error there)
+            from ..analysis.compress import guard_quantizable
+
+            val = tensor._value if isinstance(tensor, Tensor) \
+                else tensor
+            if guard_quantizable(
+                    op in (ReduceOp.SUM, ReduceOp.AVG),
+                    bool(jnp.issubdtype(jnp.asarray(val).dtype,
+                                        jnp.floating)),
+                    cfg, where="all_reduce(compress=)"):
+                return _quantized_all_reduce_in_trace(
+                    tensor, op, axes[0], cfg)
+
         def _k(v):
             return _reduce_in_trace(v, op, axes)
 
@@ -331,6 +413,50 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return Tensor(result, stop_gradient=True, _internal=True)
     # single-controller eager: global array already holds the sum
     return tensor
+
+
+def _trace_axis_size(ax):
+    """Static size of a mesh axis named in the current trace."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is not None and ax in mesh.shape:
+        return int(mesh.shape[ax])
+    return 1
+
+
+def _quantized_all_reduce_in_trace(tensor, op, ax, cfg):
+    """Traced quantized allreduce (stateless leg of
+    distributed.compress.allreduce): ravel -> pad to the W*block
+    multiple -> two-phase quantized reduce -> slice/reshape back.
+    SUM and AVG only — guard_quantizable vetted the request."""
+    from . import compress as _compress_mod
+
+    mesh = mesh_mod.get_mesh()
+    W = int(mesh.shape[ax]) if mesh is not None and ax in mesh.shape \
+        else 1
+
+    def _kq(v):
+        shape, dtype = v.shape, v.dtype
+        flat = jnp.ravel(v).astype(jnp.float32)
+        blk = _compress_mod.effective_block(cfg, flat.size, W)
+        L = _compress_mod.padded_elems(cfg, flat.size, W)
+        if L != flat.size:
+            flat = jnp.pad(flat, (0, L - flat.size))
+        _set_wire_bytes(_compress_mod.wire_bytes_of(cfg, L,
+                                                    block=blk))
+        out, _ = _compress_mod.all_reduce_flat(flat, ax, W, cfg,
+                                               block=blk)
+        if op == ReduceOp.AVG:
+            out = out / np.float32(W)
+        n = int(np.prod(shape)) if shape else 1
+        return out[:n].reshape(shape).astype(dtype)
+
+    out = apply_op("c_allreduce_q", _kq, tensor)
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        tensor._node = out._node
+        tensor._out_index = out._out_index
+        return tensor
+    return out
 
 
 def _gather_all_axes(v, axes):

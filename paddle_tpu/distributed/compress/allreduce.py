@@ -1,0 +1,215 @@
+"""Quantized allreduce — compress, reduce-scatter low-precision,
+requantize, all-gather (EQuARX, arxiv 2506.17615).
+
+Runs INSIDE a shard_map island over ONE data-parallel mesh axis, on
+the flat packed f32 gradient buffer (pack.py). For a W-rank axis the
+local (L,) contribution is viewed as W segments of S = L // W:
+
+  phase 1  quantize all W segments blockwise, `lax.all_to_all` the
+           codes+scales so rank r ends up holding every rank's
+           segment r, dequantize and accumulate in f32 — the
+           reduce-scatter leg, int8/fp8 on the wire;
+  phase 2  requantize the reduced segment, `lax.all_gather`
+           codes+scales, dequantize — every rank reconstructs the
+           identical full reduced vector.
+
+Error feedback (":ef"): the residual carries THIS rank's quantization
+error in local-contribution units. Phase 1 adds the residual before
+quantizing and keeps `e - deq(Q(e))`; phase 2's error on the segment
+this rank owns (`reduced - deq(Q(reduced))`) is added into the
+residual at that segment — re-contributed by exactly one rank next
+step, so the long-run reduced sum is unbiased. The residual buffer is
+state: the compiled train step donates it and the elastic checkpoint
+snapshots it (PTA080 guards the never-donated case).
+
+Wire accounting: `comm/all_reduce/wire_bytes` uses the SAME
+logical-per-rank-payload convention as `comm/<op>/bytes` — codes are
+counted once (as the fp32 payload is, even though a real ring
+allreduce moves ~2x either way, so the fp32:quantized RATIO is exact)
+plus both phases' scale sidecars, which are genuinely extra traffic.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core import monitor as _monitor
+from ...monitor import chaos as _chaos
+from ...monitor import flight as _flight
+from . import kernels as K
+
+__all__ = ["account", "all_reduce_flat", "effective_block",
+           "padded_elems", "padded_len", "reduce_tree",
+           "wire_bytes_of"]
+
+
+def effective_block(cfg, total, nranks):
+    """The scale block actually used for a `total`-element payload:
+    cfg.block, clamped to the 128-multiple covering ONE rank's
+    segment. Without the clamp a small payload pads to W * block and
+    the 'compressed' wire can exceed the fp32 one (found driving a
+    676-param model at the default 1024 block: 8256 wire vs 2704
+    logical bytes); with it, padding is bounded by one 128-lane row
+    per rank."""
+    seg = -(-int(total) // int(nranks))
+    seg128 = max(128, -(-seg // 128) * 128)
+    return min(cfg.block, seg128)
+
+
+def padded_elems(cfg, total, nranks):
+    """Elements actually put on the wire for a `total`-element
+    payload: the quantized pipeline pads to a W*block multiple (the
+    pads cross the wire and are counted); the fp32 twin needs no
+    padding — its psum runs on the exact payload, so its measured
+    wire is never inflated in the compressed path's favor."""
+    if cfg is None or cfg.mode == "fp32":
+        return int(total)
+    return padded_len(total, nranks, effective_block(cfg, total,
+                                                     nranks))
+
+
+def wire_bytes_of(cfg, elems, block=None):
+    """Logical per-rank wire payload of one (possibly quantized)
+    allreduce over `elems` on-wire f32 elements (see module
+    docstring for the convention). `block` is the effective scale
+    block (default cfg.block)."""
+    if cfg is None or cfg.mode == "fp32":
+        return elems * 4
+    nblocks = elems // (block or cfg.block)
+    return (elems * K.wire_itemsize(cfg.mode)
+            + 2 * nblocks * 4)
+
+
+def account(cfg, logical_bytes, elems, where="train_step",
+            block=None):
+    """Trace-time comm accounting for one (possibly quantized)
+    gradient allreduce — the counters/flight convention of
+    collective._instrumented, priced once per program build like
+    every in-trace collective. `elems` is the on-wire element count
+    (padded_elems), `block` the effective scale block."""
+    wire = wire_bytes_of(cfg, elems, block=block)
+    _monitor.stat_add("comm/all_reduce/calls", 1)
+    _monitor.stat_add("comm/all_reduce/bytes", int(logical_bytes))
+    _monitor.stat_add("comm/all_reduce/wire_bytes", int(wire))
+    if _flight.recorder.enabled:
+        # a plain ring event (not a begin/end in-flight pair): the
+        # pricing happens once at trace time, there is no in-flight
+        # interval for the watchdog to track
+        _flight.record(
+            "comm_compress", op="all_reduce",
+            bytes=int(logical_bytes), wire_bytes=int(wire),
+            compress=(cfg.spec() if cfg is not None else "fp32"),
+            group=where)
+    return wire
+
+
+def _maybe_bitflip(q, cfg, block):
+    """`comm_compress` chaos site, `bitflip` fault (site-interpreted):
+    XOR bit 6 into every code of scale block 0 — a deterministic
+    persistent wire corruption baked into THIS program build (the
+    injection fires at trace time, like every in-trace chaos site).
+    Disarmed builds never reach this branch."""
+    act = _chaos.hit("comm_compress", mode=cfg.mode,
+                     block=int(block))
+    if act is None or act.fault != "bitflip":
+        return q
+    flat = q.reshape(-1)
+    blk = flat[:block]
+    if q.dtype == jnp.int8:
+        corrupt = jnp.bitwise_xor(blk, jnp.int8(0x40))
+    else:
+        bits = lax.bitcast_convert_type(blk.astype(jnp.bfloat16),
+                                        jnp.uint16)
+        corrupt = lax.bitcast_convert_type(
+            jnp.bitwise_xor(bits, jnp.uint16(0x40)), jnp.bfloat16)
+    return flat.at[:block].set(corrupt).reshape(q.shape)
+
+
+def all_reduce_flat(flat, axis, nranks, cfg, residual=None,
+                    block=None):
+    """SUM-allreduce the local flat f32 buffer across mesh axis
+    `axis` (W = `nranks` static). `flat` length must be a multiple of
+    W * block (pack.py guarantees it; `block` is the EFFECTIVE scale
+    block — effective_block() — default cfg.block). Returns
+    (reduced_sum, new_residual) — new_residual is None unless
+    `residual` (same shape as flat) was given and cfg.ef is on.
+
+    Must be called inside a shard_map body with `axis` bound.
+    """
+    mode = cfg.mode if cfg is not None else "fp32"
+    if mode == "fp32":
+        return lax.psum(flat, axis), residual
+    block = int(block or cfg.block)
+
+    W = int(nranks)
+    L = int(flat.shape[0])
+    S = L // W
+    x = flat
+    use_ef = cfg.ef and residual is not None
+    if use_ef:
+        x = x + residual
+    x2 = x.reshape(W, S)
+
+    # phase 1: blockwise quantize + all_to_all (the reduce-scatter
+    # leg: after the exchange, row i holds rank i's segment of MY
+    # output shard)
+    q, s = K.quantize_blocks(x2, block, mode)
+    if _chaos._armed:
+        q = _maybe_bitflip(q, cfg, block)
+    if use_ef:
+        roundtrip = K.dequantize_blocks(q, s, block, mode)
+        new_res = x - roundtrip.reshape(L)
+    qr = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sr = lax.all_to_all(s.reshape(W, S // block), axis,
+                        split_axis=0, concat_axis=0, tiled=True)
+    reduced = jnp.sum(
+        K.dequantize_blocks(qr, sr.reshape(-1), block, mode),
+        axis=0)  # (S,) f32 — my output segment, accumulated in f32
+
+    # phase 2: requantize the reduced segment, all_gather, dequantize
+    q2, s2 = K.quantize_blocks(reduced, block, mode)
+    if use_ef:
+        # this rank owns output segment == its axis index: the
+        # requantization error re-enters the sum through exactly one
+        # rank's residual
+        err2 = reduced - K.dequantize_blocks(q2, s2, block, mode)
+        me = lax.axis_index(axis)
+        mask = (lax.iota(jnp.int32, W) == me).astype(jnp.float32)
+        new_res = (new_res.reshape(W, S)
+                   + mask[:, None] * err2[None, :]).reshape(L)
+    qg = lax.all_gather(q2, axis, axis=0)          # (W, S)
+    sg = lax.all_gather(s2, axis, axis=0)          # (W, S//block)
+    out = K.dequantize_blocks(qg, sg.reshape(-1), block,
+                              mode).reshape(L)
+    return out, (new_res if use_ef else residual)
+
+
+def padded_len(total, nranks, block):
+    """Smallest L >= total with L % (nranks * block) == 0 — the flat
+    buffer length every rank packs to."""
+    unit = int(nranks) * int(block)
+    return int(-(-int(total) // unit) * unit) if total else unit
+
+
+def reduce_tree(grads, segs, axis, nranks, cfg, residual=None):
+    """Pack a gradient pytree (dict name->array) into ONE flat f32
+    buffer (pack.py segs), quantized-SUM-allreduce it, unpack, and
+    divide by W — the data-parallel MEAN the GSPMD path computes
+    implicitly. Returns (mean_grads, new_residual)."""
+    from . import pack as P
+
+    total = P.total_elems(segs)
+    flat = P.pack_flat(segs, grads,
+                       padded_elems(cfg, total, nranks))
+    blk = (effective_block(cfg, total, nranks)
+           if cfg is not None and cfg.mode != "fp32" else None)
+    summed, new_res = all_reduce_flat(flat, axis, nranks, cfg,
+                                      residual=residual, block=blk)
+    mean = summed / np.float32(nranks)
+    shapes = {n: np.shape(grads[n]) for n, _ in segs}
+    dtypes = {n: grads[n].dtype for n, _ in segs}
+    out = P.unpack_flat(segs, mean, shapes)
+    return {n: out[n].astype(dtypes[n]) for n in out}, new_res

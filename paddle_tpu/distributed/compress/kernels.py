@@ -1,0 +1,188 @@
+"""Blockwise quantize/dequantize kernels for quantized collectives.
+
+EQuARX-style (PAPERS.md, arxiv 2506.17615) blockwise compression of a
+flat f32 communication buffer: the buffer is viewed as (nblocks, B)
+rows — B contiguous elements per scale block, the same
+flatten/pad/concat discipline as the PR-8 fused-optimizer packers
+(incubate/nn/pallas/optim.py) — and each block carries ONE f32
+abs-max scale:
+
+    int8  codes = round(x / (absmax/127)) in [-127, 127]   (1 B/elem)
+    fp8   codes = f8e4m3(x / (absmax/448)) on a bf16 wire
+          carrier (2 B/elem — XLA collectives on every backend move
+          bf16; the e4m3 cast is the lossy step, the carrier is not)
+
+Two implementations with BIT-IDENTICAL semantics, test-gated against
+each other in interpret mode (tests/test_comm_compress.py):
+
+  * `*_ref` — plain jnp, runs anywhere (this is what compiled train
+    steps use on CPU and whenever PADDLE_PALLAS_FUSION is off);
+  * Pallas TPU kernels behind PADDLE_PALLAS_FUSION=1 (+
+    PADDLE_PALLAS_INTERPRET=1 on CPU), grid over scale blocks.
+    int8 only — the f8e4m3 cast stays on the jnp path. Block shape
+    (1, B) favors clarity over sublane occupancy (int8 min tile is
+    (32, 128)); on-chip row-batching is a measured-on-chip follow-up,
+    like the rest of the CPU-validated kernel library.
+
+A zero block (absmax 0) gets scale 1.0 so the codes are exactly 0 and
+dequantize returns exactly 0 — padding is bit-neutral through the
+whole pipeline.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_blocks", "dequantize_blocks", "quantize_ref",
+           "dequantize_ref", "wire_dtype", "wire_itemsize",
+           "INT8_QMAX", "FP8_MAX"]
+
+INT8_QMAX = 127.0
+FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def wire_dtype(mode):
+    """The dtype that actually crosses the wire for a compress mode."""
+    if mode == "int8":
+        return jnp.int8
+    if mode == "fp8":
+        return jnp.bfloat16  # e4m3 values on a bf16 carrier
+    return jnp.float32
+
+
+def wire_itemsize(mode):
+    return jnp.dtype(wire_dtype(mode)).itemsize
+
+
+def _as_blocks(flat, block):
+    n = flat.shape[-1] if flat.ndim else flat.size
+    total = int(flat.size)
+    if total % block:
+        raise ValueError(
+            f"compress: buffer of {total} elements is not a multiple "
+            f"of the scale block ({block}) — pack/pad upstream")
+    del n
+    return flat.reshape(-1, block)
+
+
+def _block_scales(xb, qmax):
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    return jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+
+
+def quantize_ref(flat, block, mode):
+    """flat f32 (any shape, size % block == 0) -> (codes, scales).
+    codes: wire-dtype array of flat's shape; scales: f32 (size/block,).
+    """
+    shape = flat.shape
+    xb = _as_blocks(flat.astype(jnp.float32), block)
+    if mode == "int8":
+        s = _block_scales(xb, INT8_QMAX)
+        q = jnp.clip(jnp.round(xb / s[:, None]), -INT8_QMAX,
+                     INT8_QMAX).astype(jnp.int8)
+    elif mode == "fp8":
+        s = _block_scales(xb, FP8_MAX)
+        q = (xb / s[:, None]).astype(jnp.float8_e4m3fn) \
+            .astype(jnp.bfloat16)
+    else:
+        raise ValueError(f"compress: unknown quantize mode {mode!r}")
+    return q.reshape(shape), s
+
+
+def dequantize_ref(codes, scales, block, mode):
+    """Inverse of quantize_ref: wire codes + per-block scales -> f32
+    of codes' shape."""
+    shape = codes.shape
+    qb = _as_blocks(codes, block).astype(jnp.float32)
+    out = qb * scales.reshape(-1, 1)
+    del mode
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Pallas int8 kernels (one grid step == one scale block)
+# ---------------------------------------------------------------------------
+
+def _quant_i8_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...]
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0)
+    s_ref[0, 0] = scale
+    q_ref[...] = jnp.clip(jnp.round(x / scale), -INT8_QMAX,
+                          INT8_QMAX).astype(jnp.int8)
+
+
+def _dequant_i8_kernel(q_ref, s_ref, x_ref):
+    x_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _quantize_pallas_i8(flat, block, interpret):
+    from jax.experimental import pallas as pl
+
+    xb = _as_blocks(flat.astype(jnp.float32), block)
+    nb = xb.shape[0]
+    row = pl.BlockSpec((1, block), lambda i: (i, 0))
+    scale = pl.BlockSpec((1, 1), lambda i: (i, 0))
+    q, s = pl.pallas_call(
+        _quant_i8_kernel,
+        out_shape=(jax.ShapeDtypeStruct((nb, block), jnp.int8),
+                   jax.ShapeDtypeStruct((nb, 1), jnp.float32)),
+        grid=(nb,),
+        in_specs=[row],
+        out_specs=(row, scale),
+        interpret=interpret,
+    )(xb)
+    return q.reshape(flat.shape), s.reshape(nb)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _dequantize_pallas_i8(codes, scales, block, interpret):
+    from jax.experimental import pallas as pl
+
+    qb = _as_blocks(codes, block)
+    nb = qb.shape[0]
+    row = pl.BlockSpec((1, block), lambda i: (i, 0))
+    scale = pl.BlockSpec((1, 1), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _dequant_i8_kernel,
+        out_shape=jax.ShapeDtypeStruct((nb, block), jnp.float32),
+        grid=(nb,),
+        in_specs=[row, scale],
+        out_specs=row,
+        interpret=interpret,
+    )(qb, scales.reshape(nb, 1))
+    return out.reshape(codes.shape)
+
+
+def _use_pallas(mode):
+    if mode != "int8":
+        return False
+    from ...incubate.nn import pallas as _pallas
+
+    return _pallas.fusion_enabled()
+
+
+def quantize_blocks(flat, block, mode):
+    """Dispatching entry: Pallas int8 kernel when the fused kernel
+    library is armed (PADDLE_PALLAS_FUSION=1; interpret mode off-TPU),
+    jnp reference otherwise. Same results either way."""
+    if _use_pallas(mode):
+        from ...incubate.nn import pallas as _pallas
+
+        return _quantize_pallas_i8(
+            flat, block,
+            _pallas.interpret_mode() and not _pallas._on_tpu())
+    return quantize_ref(flat, block, mode)
+
+
+def dequantize_blocks(codes, scales, block, mode):
+    if _use_pallas(mode):
+        from ...incubate.nn import pallas as _pallas
+
+        return _dequantize_pallas_i8(
+            codes, scales, block,
+            _pallas.interpret_mode() and not _pallas._on_tpu())
+    return dequantize_ref(codes, scales, block, mode)

@@ -1,0 +1,55 @@
+"""Flat-buffer packing for quantized collectives.
+
+The PR-8 fused-optimizer packer discipline (incubate/nn/pallas/
+optim.py: stable name order, true element counts, zero padding that
+is bit-neutral through the kernel) applied to the communication
+buffer: every gradient raveled to f32, concatenated in a stable seg
+order, zero-padded to the allreduce's (W * block)-multiple length.
+Zero pads quantize to exactly 0 and contribute exactly 0 to the
+reduced sum, so padding never perturbs the math — only the wire
+accounting, which honestly counts it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["segments", "total_elems", "pack_flat", "unpack_flat"]
+
+
+def segments(names, arrays):
+    """(name, n_elems) per packed tensor, in the given stable order —
+    true element counts (optim.py _segments), offsets derived on
+    unpack."""
+    return [(n, int(np.prod(np.shape(arrays[n]), dtype=np.int64)))
+            for n in names]
+
+
+def total_elems(segs):
+    return sum(ne for _, ne in segs)
+
+
+def pack_flat(segs, arrays, padded):
+    """arrays: name -> array (any shape/dtype). Returns the (padded,)
+    f32 buffer."""
+    flats = [jnp.ravel(arrays[n]).astype(jnp.float32)
+             for n, _ in segs]
+    flat = (jnp.concatenate(flats) if flats
+            else jnp.zeros((0,), jnp.float32))
+    pad = int(padded) - flat.shape[0]
+    if pad < 0:
+        raise ValueError(
+            f"compress.pack: padded length {padded} < payload "
+            f"{flat.shape[0]}")
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def unpack_flat(segs, flat, shapes):
+    out = {}
+    off = 0
+    for n, ne in segs:
+        out[n] = flat[off:off + ne].reshape(shapes[n])
+        off += ne
+    return out

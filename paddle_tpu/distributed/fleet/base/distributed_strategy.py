@@ -130,18 +130,24 @@ _CONFIG_FIELDS = {
 # raises NotImplementedError here, at the assignment site (falsy
 # assignment is allowed so ported code that resets defaults works).
 _APPROX_GRAD_RATIONALE = (
-    "lossy gradient-compression optimizers are intentionally "
-    "unsupported on TPU: in-step allreduce over ICI is exact and "
-    "bandwidth-cheap, so compressing gradients would only hurt "
-    "convergence. (LocalSGD, an EXACT algorithm, IS supported — see "
-    "fleet/meta_optimizers.)")
+    "DGC's top-k gradient sparsification is intentionally "
+    "unsupported on TPU: its NCCL-shaped sparse exchange has no ICI "
+    "analog. Bandwidth-bound dp DOES have a supported path now — "
+    "the EQuARX-style blockwise-quantized allreduce with error "
+    "feedback (PADDLE_COMM_COMPRESS=int8:ef / "
+    "DistributedTrainStepCompiler(comm_compress=...), "
+    "distributed.compress), which is measured (comm/all_reduce/"
+    "wire_bytes) and loss-parity test-gated. (LocalSGD, an EXACT "
+    "algorithm, is also supported — see fleet/meta_optimizers.)")
 _UNSUPPORTED = {
     "dgc": _APPROX_GRAD_RATIONALE,
     "dgc_configs": _APPROX_GRAD_RATIONALE,
     "fp16_allreduce": (
         "grad-allreduce runs inside the compiled step where XLA already "
         "keeps bf16 grads in bf16 over ICI; a separate cast-for-comm "
-        "pass would be a no-op or a precision lie."),
+        "pass would be a no-op or a precision lie. For a REAL wire "
+        "reduction use PADDLE_COMM_COMPRESS=int8|fp8[:ef] "
+        "(distributed.compress)."),
     "heter_ccl_mode": (
         "heterogeneous (CPU+GPU mixed) collective mode has no TPU "
         "analog: a TPU pod is homogeneous and XLA owns the collective "

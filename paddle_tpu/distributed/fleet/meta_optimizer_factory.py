@@ -19,12 +19,14 @@ step, applied here in the same precedence order:
   localsgd/adaptive_..   -> LocalSGDOptimizer wrapper (exact k-step
                             local training + periodic delta-averaging
                             over the eager collective world — r5)
-  dgc                    -> raise NotImplementedError: lossy gradient
-                            compression exists to cut NCCL bandwidth;
-                            ICI allreduce is cheap and exact, so it
-                            would only hurt convergence (explicit
+  dgc                    -> raise NotImplementedError: top-k sparse
+                            exchange has no ICI analog (explicit
                             design refusal — the flag errors instead
-                            of silently lying).
+                            of silently lying). The supported
+                            bandwidth lever is the quantized
+                            allreduce: PADDLE_COMM_COMPRESS=
+                            int8|fp8[:ef] (distributed.compress,
+                            ISSUE 14).
 """
 from __future__ import annotations
 

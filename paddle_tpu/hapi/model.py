@@ -110,7 +110,7 @@ class Model:
             # step counter; materialized with this compiler's own
             # shardings at first build, so a reshaped mesh re-shards
             comp.restore_state(pend["slots"], pend["step"],
-                               pend.get("accum"))
+                               pend.get("accum"), pend.get("comm"))
         return comp
 
     @staticmethod
@@ -799,8 +799,13 @@ class Model:
         if comp is not None:
             slots = comp._opt_state
             accum = comp._accum_state or None
+            # quantized-collective error-feedback residuals
+            # (distributed.compress): part of the exact training
+            # state — a resume without them re-feeds stale error
+            comm = comp._comm_state or None
         else:
             accum = None
+            comm = None
             slots = {}
             if self._optimizer is not None:
                 # eager accumulators key by p.name (process-specific
@@ -818,6 +823,7 @@ class Model:
             "model": dict(self.network.state_dict()),
             "opt_slots": slots,
             "opt_accum": accum,
+            "opt_comm": comm,
             "opt_meta": opt_meta,
             "rng": {"key": np.asarray(key_data),
                     "counter": int(counter)},
@@ -856,6 +862,7 @@ class Model:
         self._pending_opt_restore = {
             "slots": slots,
             "accum": state.get("opt_accum"),
+            "comm": state.get("opt_comm"),
             "step": int(cur.get("global_step", 0))}
         # a live compiler from a PREVIOUS fit holds pre-restore state;
         # retire it so the next build starts from the checkpoint

@@ -878,6 +878,12 @@ class TrainStepCompiler:
                                      or grad_scaler is not None)
         self.last_skips = 0  # nonfinite trips in the last dispatch
         self._accum_state = None
+        # comm-compression state (distributed.compress): the
+        # error-feedback residual buffers, donated like opt/accum
+        # state. {} on every uncompressed step — an empty pytree adds
+        # no inputs, so the lowered program is unchanged
+        self._comm_state = None
+        self._compress = None  # set by DistributedTrainStepCompiler
         self._compiled = None
         self._names = None
         self._opt_state = None
@@ -885,6 +891,7 @@ class TrainStepCompiler:
         self._mem_analysis = None  # memory_analysis() byte dict
         self._restored_opt = None    # elastic-checkpoint preload
         self._restored_accum = None  # (applied at first build)
+        self._restored_comm = None
         _live_compiled.add(self)
 
     def _params_and_buffers(self):
@@ -903,7 +910,9 @@ class TrainStepCompiler:
                      for b in batch)
 
     def _jit_step(self, step_fn, trainable, frozen, bufs, batch):
-        donate = (0, 1, 2) if self._donate else ()
+        # argnums (0, 1, 2, 3): params, optimizer slots, grad-merge
+        # accumulators, comm-compression residuals
+        donate = (0, 1, 2, 3) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
     def lower_compiled(self, *batch):
@@ -921,8 +930,9 @@ class TrainStepCompiler:
         lr = np.float32(self._opt.get_lr())
         rngc = np.uint32(self._step)
         return self._compiled.lower(
-            pvals, self._opt_state, self._accum_state, fvals, bvals,
-            avals, lr, rngc, self._loss_scale()).compile()
+            pvals, self._opt_state, self._accum_state,
+            self._comm_state, fvals, bvals, avals, lr, rngc,
+            self._loss_scale()).compile()
 
     def _loss_scale(self):
         """The host-scalar loss scale this dispatch runs at (1.0
@@ -1002,8 +1012,9 @@ class TrainStepCompiler:
             lr = np.float32(self._opt.get_lr())
             rngc = np.uint32(self._step)
             lowered = self._compiled.lower(
-                pvals, self._opt_state, self._accum_state, fvals,
-                bvals, avals, lr, rngc, self._loss_scale())
+                pvals, self._opt_state, self._accum_state,
+                self._comm_state, fvals, bvals, avals, lr, rngc,
+                self._loss_scale())
             label = f"train_step:{type(self._model).__name__}"
             k = self._steps_per_dispatch
             if k != 1:
@@ -1091,17 +1102,20 @@ class TrainStepCompiler:
             san_site = (f"train_step:{type(self._model).__name__}"
                         f" dispatch#{self._step}")
             _sanitize.check_args(
-                (pvals, self._opt_state, self._accum_state, fvals,
-                 bvals, avals), site=san_site)
+                (pvals, self._opt_state, self._accum_state,
+                 self._comm_state, fvals, bvals, avals),
+                site=san_site)
         # host scalars (jit globalizes them under any mesh/process set)
         lr = np.float32(self._opt.get_lr())
         rngc = np.uint32(self._step)
         prev_opt, prev_acc = self._opt_state, self._accum_state
+        prev_comm = self._comm_state
         try:
-            new_p, new_opt, new_acc, new_b, loss, skips = \
+            new_p, new_opt, new_acc, new_comm, new_b, loss, skips = \
                 self._compiled(
-                    pvals, self._opt_state, self._accum_state, fvals,
-                    bvals, avals, lr, rngc, self._loss_scale())
+                    pvals, self._opt_state, self._accum_state,
+                    self._comm_state, fvals, bvals, avals, lr, rngc,
+                    self._loss_scale())
         except RuntimeError as e:
             if _sanitize._donation:
                 better = _sanitize.explain_deleted(
@@ -1110,14 +1124,15 @@ class TrainStepCompiler:
                     raise better from e
             raise
         if _sanitize._donation and self._donate:
-            # the program just donated argnums (0, 1, 2): register
-            # the OLD params/opt-state/accumulators with this
-            # dispatch site so any later use of a retained reference
-            # reports PTA041 with both ends named
-            _sanitize.note_donated((pvals, prev_opt, prev_acc),
-                                   site=san_site)
+            # the program just donated argnums (0, 1, 2, 3): register
+            # the OLD params/opt-state/accumulators/comm residuals
+            # with this dispatch site so any later use of a retained
+            # reference reports PTA041 with both ends named
+            _sanitize.note_donated((pvals, prev_opt, prev_acc,
+                                    prev_comm), site=san_site)
         self._opt_state = new_opt
         self._accum_state = new_acc
+        self._comm_state = new_comm
         for k, p in trainable.items():
             p._value = new_p[k]
         for k, b in bufs.items():
@@ -1171,24 +1186,37 @@ class TrainStepCompiler:
             {k: jnp.zeros(p._value.shape, jnp.float32)
              for k, p in t_items}
             if self._accum_steps > 1 else {})
+        self._comm_state = self._init_comm_state(t_items)
 
-    def restore_state(self, slots, step, accum=None):
+    def _init_comm_state(self, t_items):
+        """Comm-compression state (error-feedback residuals). Base
+        compiler: no mesh, nothing to compress — an empty pytree that
+        leaves the lowered program untouched. Overridden by
+        DistributedTrainStepCompiler."""
+        return {}
+
+    def restore_state(self, slots, step, accum=None, comm=None):
         """Preload optimizer state captured by an elastic checkpoint
         (incubate.checkpoint.elastic): `slots` is the host pytree
         {param_name: {slot: array}} a snapshot recorded off a live
         compiler's _opt_state (or the eager accumulators), `step` the
         global microstep counter (it seeds the per-dispatch rng
         fold-in, so bit-identical resume NEEDS it), `accum` the
-        gradient-merge buffers mid-window. The arrays are materialized
-        — with this compiler's slot shardings, so a RESHAPED mesh
-        re-shards them — when the step first builds; adopting a
-        sibling's live state supersedes the preload."""
+        gradient-merge buffers mid-window, `comm` the quantized-
+        collective error-feedback residuals (exact EF resume). The
+        arrays are materialized — with this compiler's slot
+        shardings, so a RESHAPED mesh re-shards them — when the step
+        first builds; adopting a sibling's live state supersedes the
+        preload."""
         self._restored_opt = {
             n: {s: np.asarray(v) for s, v in sl.items()}
             for n, sl in (slots or {}).items()}
         self._restored_accum = (
             {n: np.asarray(v) for n, v in accum.items()}
             if accum else None)
+        self._restored_comm = (
+            {n: np.asarray(v) for n, v in comm.items()}
+            if comm else None)
         self._step = int(step)
 
     def _apply_restored_state(self):
@@ -1216,6 +1244,17 @@ class TrainStepCompiler:
                         tuple(np.shape(ref)):
                     self._accum_state[name] = jax.device_put(
                         host.astype(ref.dtype), ref.sharding)
+        rcomm, self._restored_comm = self._restored_comm, None
+        if rcomm and self._comm_state:
+            # a reshaped data axis changes the residual's per-rank
+            # layout (leading dim = W): shape mismatches keep the
+            # fresh zeros — bit-exact EF resume is a same-W contract
+            for name, host in rcomm.items():
+                ref = self._comm_state.get(name)
+                if ref is not None and tuple(np.shape(host)) == \
+                        tuple(np.shape(ref)):
+                    self._comm_state[name] = jax.device_put(
+                        host.astype(ref.dtype), ref.sharding)
 
     def adopt_state_from(self, other):
         """Take over `other`'s live optimizer/accumulator state and
@@ -1230,7 +1269,18 @@ class TrainStepCompiler:
         # live adopted state supersedes a checkpoint preload
         self._restored_opt = None
         self._restored_accum = None
+        self._restored_comm = None
         self._opt_state = other._opt_state
+        # comm residuals only transfer between same-policy siblings
+        # (a differently-configured sibling's buffers have the wrong
+        # shape/meaning — start fresh like a changed merge width)
+        same_comm = getattr(other, "_compress", None) == self._compress
+        if same_comm:
+            self._comm_state = other._comm_state
+        else:
+            self._comm_state = self._init_comm_state(
+                [(k, p) for k, p in self._model.named_parameters()
+                 if p.trainable])
         if self._accum_steps == getattr(other, "_accum_steps", 1):
             self._accum_state = other._accum_state
         elif self._accum_steps > 1:
@@ -1244,7 +1294,12 @@ class TrainStepCompiler:
         else:
             self._accum_state = {}
         self._step = other._step
-        for attr in ("_slot_shardings", "_accum_shardings"):
+        # _comm_shardings only when the residuals transferred too —
+        # a different-policy sibling's layout describes ITS buffers
+        attrs = ["_slot_shardings", "_accum_shardings"]
+        if same_comm:
+            attrs.append("_comm_shardings")
+        for attr in attrs:
             if hasattr(other, attr) and getattr(other, attr) is not None:
                 setattr(self, attr, getattr(other, attr))
 
@@ -1317,29 +1372,12 @@ class TrainStepCompiler:
         k_merge = self._accum_steps
         k_dispatch = self._steps_per_dispatch
         guard = self._guard_nonfinite
-        use_scale = self._grad_scaler is not None
 
-        def one_step(pvals, opt_state, accum, fvals, bvals, avals, lr,
-                     rngc, scale):
-            if use_scale:
-                # dynamic loss scaling (check_finite_and_unscale +
-                # update_loss_scaling, fused): backward runs on the
-                # SCALED loss, gradients unscale before guard/apply,
-                # the user-visible loss stays unscaled (aux)
-                def scaled_loss_of(pv, fv, bv, av, rc):
-                    loss, nb = loss_of(pv, fv, bv, av, rc)
-                    return loss * scale, (loss, nb)
-
-                (_, (loss, new_bvals)), grads = jax.value_and_grad(
-                    scaled_loss_of, has_aux=True)(pvals, fvals, bvals,
-                                                  avals, rngc)
-                inv = (np.float32(1.0) / scale)
-                grads = {n: (g.astype(jnp.float32) * inv).astype(
-                    g.dtype) for n, g in grads.items()}
-            else:
-                (loss, new_bvals), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(pvals, fvals, bvals, avals,
-                                           rngc)
+        def one_step(pvals, opt_state, accum, comm, fvals, bvals,
+                     avals, lr, rngc, scale):
+            loss, new_bvals, grads, new_comm = self._grads_and_loss(
+                loss_of, pvals, fvals, bvals, avals, rngc, scale,
+                comm)
 
             if guard:
                 # fused all-finite predicate over loss + every grad
@@ -1362,6 +1400,13 @@ class TrainStepCompiler:
                              for n, g in grads.items()}
                     new_bvals = {k: jnp.where(ok, v, bvals[k])
                                  for k, v in new_bvals.items()}
+                # a tripped step must not keep a residual computed
+                # from non-finite gradients (quantizing inf poisons
+                # the error buffer forever) — pass the old one
+                # through, mirroring the opt-state passthrough
+                new_comm = tree_util.tree_map(
+                    lambda nc, oc: jnp.where(ok, nc, oc), new_comm,
+                    comm)
 
             def _apply_all(_):
                 if k_merge <= 1:
@@ -1404,31 +1449,63 @@ class TrainStepCompiler:
                 new_p, new_s, new_acc, new_b = _apply_all(None)
                 skip = ((~ok).astype(jnp.uint32) if guard
                         else jnp.uint32(0))
-            return new_p, new_s, new_acc, new_b, loss, skip
+            return new_p, new_s, new_acc, new_comm, new_b, loss, skip
 
         if k_dispatch <= 1:
             step_fn = one_step
         else:
             # fused multi-step dispatch: scan the SAME one_step body
             # over K stacked microbatches, carrying the donated
-            # (params, opt_state, accum, buffers) entirely on device.
-            # frozen params, lr and the loss scale broadcast
-            # (closure); rng counters advance per microstep so random
-            # streams match K sequential dispatches bit-for-bit.
-            def step_fn(pvals, opt_state, accum, fvals, bvals, avals,
-                        lr, rngc, scale):
+            # (params, opt_state, accum, comm residuals, buffers)
+            # entirely on device. frozen params, lr and the loss
+            # scale broadcast (closure); rng counters advance per
+            # microstep so random streams match K sequential
+            # dispatches bit-for-bit.
+            def step_fn(pvals, opt_state, accum, comm, fvals, bvals,
+                        avals, lr, rngc, scale):
                 def body(carry, xs):
-                    p, s, acc, bv = carry
+                    p, s, acc, cm, bv = carry
                     av, rc = xs
-                    p, s, acc, bv, loss, skip = one_step(
-                        p, s, acc, fvals, bv, av, lr, rc, scale)
-                    return (p, s, acc, bv), (loss, skip)
+                    p, s, acc, cm, bv, loss, skip = one_step(
+                        p, s, acc, cm, fvals, bv, av, lr, rc, scale)
+                    return (p, s, acc, cm, bv), (loss, skip)
 
                 rcs = rngc + jnp.arange(k_dispatch, dtype=jnp.uint32)
-                (p, s, acc, bv), (losses, skips) = jax.lax.scan(
-                    body, (pvals, opt_state, accum, bvals),
+                (p, s, acc, cm, bv), (losses, skips) = jax.lax.scan(
+                    body, (pvals, opt_state, accum, comm, bvals),
                     (avals, rcs))
-                return p, s, acc, bv, losses, skips
+                return p, s, acc, cm, bv, losses, skips
 
         self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
                                         batch)
+
+    def _grads_and_loss(self, loss_of, pvals, fvals, bvals, avals,
+                        rngc, scale, comm):
+        """One microstep's loss + gradients: value_and_grad over the
+        traced forward, with dynamic loss scaling unscaled here (the
+        gradients this returns are ALWAYS in unscaled units — the
+        compressed override quantizes them, and quantizing scaled
+        grads would waste code range on the scale factor). Returns
+        (loss, new_bvals, grads, new_comm); the base path has no comm
+        state to advance. Overridden by DistributedTrainStepCompiler
+        when comm compression restructures the reduction."""
+        if self._grad_scaler is not None:
+            # dynamic loss scaling (check_finite_and_unscale +
+            # update_loss_scaling, fused): backward runs on the
+            # SCALED loss, gradients unscale before guard/apply,
+            # the user-visible loss stays unscaled (aux)
+            def scaled_loss_of(pv, fv, bv, av, rc):
+                loss, nb = loss_of(pv, fv, bv, av, rc)
+                return loss * scale, (loss, nb)
+
+            (_, (loss, new_bvals)), grads = jax.value_and_grad(
+                scaled_loss_of, has_aux=True)(pvals, fvals, bvals,
+                                              avals, rngc)
+            inv = (np.float32(1.0) / scale)
+            grads = {n: (g.astype(jnp.float32) * inv).astype(
+                g.dtype) for n, g in grads.items()}
+        else:
+            (loss, new_bvals), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(pvals, fvals, bvals, avals,
+                                       rngc)
+        return loss, new_bvals, grads, comm

@@ -62,7 +62,19 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
                  amp_level=None, amp_dtype="bfloat16",
                  amp_custom_white_list=None, amp_custom_black_list=None,
                  steps_per_dispatch=1, guard_nonfinite=False,
-                 grad_scaler=None):
+                 grad_scaler=None, comm_compress=True):
+        """comm_compress: quantized-collective policy for the dp
+        gradient allreduce (distributed.compress) — a spec string
+        ("int8"/"fp8"[:ef] or the explicit "fp32" twin), a
+        CompressConfig, None/False for off, or True (default) for
+        $PADDLE_COMM_COMPRESS. When set, the gradient reduction
+        becomes an explicit shard_map island over the data axis whose
+        allreduce is measured (comm/all_reduce/{bytes,wire_bytes})
+        and — for int8/fp8 — blockwise-quantized, with optional
+        error-feedback residuals riding the donated step state. With
+        the env unset and no argument, nothing changes: the implicit
+        GSPMD psum, bit-identical to the uncompressed program."""
+        from ..distributed import compress as compress_mod
         from ..distributed import mesh as mesh_mod
 
         super().__init__(model, optimizer, loss_fn=loss_fn, donate=donate,
@@ -79,6 +91,14 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         self._sharded_params = False
         self._slot_shardings = None
         self._accum_shardings = {}
+        self._comm_shardings = {}
+        self._compress = compress_mod.resolve(comm_compress)
+        # env-driven configs DISABLE on incompatible layouts (a pod
+        # job sets the env once; its hybrid-mesh members keep GSPMD);
+        # an explicit constructor spec raises instead
+        self._compress_from_env = comm_compress is True
+        self._compress_axis = None  # resolved/validated at first build
+        self._compress_nranks = 1
 
     def _param_sharding(self, p):
         return NamedSharding(self._mesh,
@@ -104,6 +124,204 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
             lead = (None, "dp") if k > 1 else ("dp",)
             spec = P(*(lead + (None,) * (ndim - len(lead)))[:ndim])
         return NamedSharding(self._mesh, filter_spec(spec, self._mesh))
+
+    def _microbatch_spec(self, i, ndim):
+        """Sharding spec of ONE microbatch of batch element i — the
+        _batch_sharding layout minus the (unsharded) K dispatch axis;
+        what the compressed-gradient shard_map island splits on."""
+        if self._batch_specs is not None:
+            spec = self._batch_specs[i]
+            spec = P(*tuple(spec)) if spec is not None else P()
+        else:
+            spec = P(*(("dp",) + (None,) * (ndim - 1))[:ndim])
+        return filter_spec(spec, self._mesh)
+
+    def _resolve_compress(self):
+        """Validate the comm-compression config against this mesh +
+        spec set (once, at first build). The quantized allreduce is
+        the DATA-PARALLEL gradient reduction: it needs one >1-sized
+        data axis carrying the batch, replicated parameters, and no
+        other parallelism (model/pipeline shards don't have a single
+        flat gradient buffer to compress — GSPMD owns those
+        reductions). A hybrid mesh with compression explicitly
+        requested is a loud error; a degenerate data axis (W<2) just
+        disables it."""
+        cfg = self._compress
+        if cfg is None:
+            return None
+
+        def _incompatible(why):
+            if not self._compress_from_env:
+                raise ValueError(
+                    f"comm_compress={cfg.spec()!r}: {why}")
+            from ..core import monitor as _cmon
+
+            self._compress = None
+            try:
+                _cmon.VLOG(1, f"comm_compress={cfg.spec()} "
+                              f"(PADDLE_COMM_COMPRESS): {why} — "
+                              "disabled for this compiler")
+            except Exception:
+                pass
+            return None
+
+        mesh = self._mesh
+        if self._batch_specs is not None:
+            leads = set()
+            for s in self._batch_specs:
+                entry = tuple(s)[0] if s is not None and tuple(s) \
+                    else None
+                if isinstance(entry, (tuple, list)):
+                    entry = tuple(entry)
+                if entry is not None:
+                    leads.add(entry)
+            if len(leads) > 1:
+                return _incompatible(
+                    "batch elements shard their leading dim over "
+                    f"different axes {sorted(map(str, leads))} — "
+                    "one data axis is required")
+            lead = leads.pop() if leads else None
+        else:
+            lead = "dp"
+        if isinstance(lead, tuple):
+            if len(lead) != 1:
+                return _incompatible(
+                    f"the batch is sharded over multiple axes "
+                    f"{lead} — the quantized allreduce runs over "
+                    "ONE data axis")
+            lead = lead[0]
+        W = int(mesh.shape[lead]) if lead in mesh.shape else 1
+        if W < 2:
+            from ..core import monitor as _cmon
+
+            self._compress = None
+            try:
+                _cmon.VLOG(1, f"comm_compress={cfg.spec()}: data "
+                              f"axis {lead!r} has {W} shard(s) — "
+                              "nothing to compress, disabled")
+            except Exception:
+                pass
+            return None
+        others = [a for a in mesh.axis_names
+                  if a != lead and int(mesh.shape[a]) > 1]
+        if others:
+            return _incompatible(
+                f"needs a pure data-parallel mesh, but axes "
+                f"{others} are also >1 — GSPMD owns the model/"
+                "pipeline reductions on hybrid layouts")
+        mp = P()
+        for coll in (dict(self._model.named_parameters()),
+                     dict(self._model.named_buffers())):
+            for name, p in coll.items():
+                if filter_spec(getattr(p, "dist_spec", None),
+                               mesh) != mp:
+                    return _incompatible(
+                        f"needs replicated parameters, but {name!r}"
+                        f" carries dist_spec="
+                        f"{getattr(p, 'dist_spec', None)!r}")
+        self._compress_axis = lead
+        self._compress_nranks = W
+        return cfg
+
+    def _init_comm_state(self, t_items):
+        """Error-feedback residual state: ONE flat f32 buffer per
+        rank ((W, L) globally, sharded over the data axis), L = the
+        packed gradient length padded to the allreduce's W*block
+        multiple. Donated with the rest of the step state; PTA080
+        flags the never-donated configuration."""
+        cfg = self._resolve_compress()
+        self._comm_shardings = {}
+        if cfg is None or not cfg.ef:
+            return {}
+        from ..analysis.compress import guard_residual_donated
+        from ..distributed import compress as compress_mod
+
+        guard_residual_donated(
+            self._donate, cfg,
+            where=f"train_step:{type(self._model).__name__}")
+        segs = compress_mod.pack.segments(
+            [k for k, _ in t_items],
+            {k: p._value for k, p in t_items})
+        L = compress_mod.padded_elems(
+            cfg, compress_mod.pack.total_elems(segs),
+            self._compress_nranks)
+        sh = NamedSharding(self._mesh, P(self._compress_axis))
+        self._comm_shardings = {"residual": sh}
+        arr = np.zeros((self._compress_nranks, L), np.float32)
+        return {"residual": jax.device_put(arr, sh)}
+
+    def _grads_and_loss(self, loss_of, pvals, fvals, bvals, avals,
+                        rngc, scale, comm):
+        """Compressed-gradient override: the forward/backward runs
+        per-shard inside a shard_map island over the data axis, the
+        local gradients are unscaled (GradScaler) BEFORE quantizing,
+        packed into one flat buffer and pushed through the quantized
+        allreduce (distributed.compress.reduce_tree — SUM then /W,
+        the dp MEAN the GSPMD path computes implicitly); loss and
+        float buffer updates pmean across shards. Uncompressed
+        compilers keep the base path (implicit GSPMD reduction),
+        bit-identical to pre-compression programs."""
+        cfg = self._compress
+        if cfg is not None and self._compress_axis is None:
+            # state adopted from a sibling: the adopt carried the
+            # residuals but not the (idempotent) axis resolution
+            cfg = self._resolve_compress()
+        if cfg is None:
+            return super()._grads_and_loss(
+                loss_of, pvals, fvals, bvals, avals, rngc, scale,
+                comm)
+        from jax import lax
+
+        from ..distributed import compress as compress_mod
+        from ..distributed import mesh as mesh_mod
+
+        ax, W = self._compress_axis, self._compress_nranks
+        use_scale = self._grad_scaler is not None
+        names = list(pvals.keys())
+        model_name = type(self._model).__name__
+
+        def island(pv, fv, bv, av, rc, sc, cm):
+            if use_scale:
+                def scaled_loss_of(pv_, fv_, bv_, av_, rc_):
+                    loss, nb = loss_of(pv_, fv_, bv_, av_, rc_)
+                    return loss * sc, (loss, nb)
+
+                (_, (loss, nb)), grads = jax.value_and_grad(
+                    scaled_loss_of, has_aux=True)(pv, fv, bv, av, rc)
+                inv = np.float32(1.0) / sc
+                grads = {n: (g.astype(jnp.float32) * inv).astype(
+                    g.dtype) for n, g in grads.items()}
+            else:
+                (loss, nb), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(pv, fv, bv, av, rc)
+            segs = compress_mod.pack.segments(names, grads)
+            total = compress_mod.pack.total_elems(segs)
+            compress_mod.account(
+                cfg, total * 4,
+                compress_mod.padded_elems(cfg, total, W),
+                where=f"train_step:{model_name}",
+                block=compress_mod.effective_block(cfg, total, W))
+            residual = cm.get("residual")
+            res_local = residual[0] if residual is not None else None
+            grads, new_res = compress_mod.reduce_tree(
+                grads, segs, ax, W, cfg, residual=res_local)
+            loss = lax.pmean(loss, ax)
+            nb = {k: (lax.pmean(v, ax)
+                      if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                  for k, v in nb.items()}
+            new_cm = dict(cm)
+            if residual is not None:
+                new_cm["residual"] = new_res[None]
+            return loss, nb, grads, new_cm
+
+        aval_specs = tuple(self._microbatch_spec(i, np.ndim(a))
+                           for i, a in enumerate(avals))
+        repl = P()
+        body = mesh_mod.shard_map_compat(
+            island, self._mesh,
+            (repl, repl, repl, aval_specs, repl, repl, P(ax)),
+            (repl, repl, repl, P(ax)))
+        return body(pvals, fvals, bvals, avals, rngc, scale, comm)
 
     @staticmethod
     def _hostify(v):
@@ -182,9 +400,16 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         load a stale executable (the elastic reshape-resume path hits
         this: dp=8 and dp=4 x sharding=2 meshes must not collide)."""
         m = self._mesh
+        comp = self._compress
         return (tuple(m.axis_names),
                 tuple(int(m.shape[a]) for a in m.axis_names),
-                tuple(str(d) for d in np.ravel(m.devices)))
+                tuple(str(d) for d in np.ravel(m.devices)),
+                # compression policy leg: the quantized program's
+                # module text already differs, but the spec makes the
+                # digest self-describing (and block-size changes that
+                # only move padding can never collide)
+                (f"{comp.spec()}@{comp.block}" if comp is not None
+                 else ""))
 
     def _lint_shardings(self, batch):
         """PTA05x sharding-spec lints just before the first compile:
@@ -221,14 +446,17 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         for i, b in enumerate(batch):
             v = b._value if isinstance(b, Tensor) else np.asarray(b)
             batch_sh.append(self._batch_sharding(i, np.ndim(v)))
-        # inputs: (params, slots, accum, frozen, buffers, batch, lr,
-        # rngc, loss_scale); outputs add the replicated per-microstep
-        # nonfinite-skip flags after the losses
+        # inputs: (params, slots, accum, comm residuals, frozen,
+        # buffers, batch, lr, rngc, loss_scale); outputs add the
+        # replicated per-microstep nonfinite-skip flags after the
+        # losses
         in_shardings = (param_sh, self._slot_shardings,
-                        self._accum_shardings, frozen_sh, buf_sh,
-                        tuple(batch_sh), repl, repl, repl)
+                        self._accum_shardings, self._comm_shardings,
+                        frozen_sh, buf_sh, tuple(batch_sh), repl,
+                        repl, repl)
         out_shardings = (param_sh, self._slot_shardings,
-                        self._accum_shardings, buf_sh, repl, repl)
-        donate = (0, 1, 2) if self._donate else ()
+                        self._accum_shardings, self._comm_shardings,
+                        buf_sh, repl, repl)
+        donate = (0, 1, 2, 3) if self._donate else ()
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)

@@ -100,6 +100,11 @@ SITES = {
     "linalg_dispatch": "distributed linear-algebra program dispatch "
                        "(linalg.dist.runtime.dispatch — SUMMA/"
                        "factorization/eigensolver programs)",
+    "comm_compress": "quantized-allreduce build "
+                     "(distributed.compress.allreduce — fires at "
+                     "trace time like every in-trace collective; "
+                     "bitflip corrupts one wire block in the built "
+                     "program)",
 }
 
 FAULTS = {
@@ -117,6 +122,9 @@ FAULTS = {
                   "on_bad_sample policy",
     "resource_exhausted": "raise a synthetic XlaRuntimeError "
                           "RESOURCE_EXHAUSTED (OOM forensics path)",
+    "bitflip": "site-interpreted wire corruption: the quantized "
+               "allreduce XORs bit 6 into every code of scale "
+               "block 0 (comm_compress)",
 }
 
 PARAMS = {
@@ -176,7 +184,8 @@ _FLOAT_PARAMS = ("p", "ms", "secs")
 # site-interpreted faults only make sense where a call site enacts
 # the returned Rule — arming them elsewhere would count `triggered`
 # injections that never happened, corrupting the chaos/* provenance
-_SITE_INTERPRETED = {"torn": ("ckpt_write", "cache_write")}
+_SITE_INTERPRETED = {"torn": ("ckpt_write", "cache_write"),
+                     "bitflip": ("comm_compress",)}
 
 
 def _default_seed(site, fault):

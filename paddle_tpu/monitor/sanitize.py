@@ -88,6 +88,10 @@ FAMILIES = {
                 "(errors raise before compile)",
     "serving": "KV-block leak/double-free accounting in the serving "
                "engine (PTA070/PTA071)",
+    "compress": "quantized-collective invariants: error-feedback "
+                "residual never donated (PTA080), quantized "
+                "allreduce on a non-SUM op / integer dtype "
+                "(PTA081) — error findings raise",
 }
 
 PARAMS = {
@@ -101,6 +105,7 @@ _donation = False
 _locks = False
 _sharding = False
 _serving = False
+_compress = False
 _spec = ""
 _opts: dict = {}
 
@@ -262,8 +267,8 @@ def configure(spec=None):
     """Arm the families a spec describes (default: $PADDLE_SANITIZE).
     Replaces any previous configuration; empty/unset disarms. Returns
     the armed {family: params} map."""
-    global _armed, _donation, _locks, _sharding, _serving, _spec, \
-        _opts
+    global _armed, _donation, _locks, _sharding, _serving, \
+        _compress, _spec, _opts
     if spec is None:
         spec = os.environ.get("PADDLE_SANITIZE", "")
     fams = parse_spec(spec) if spec else {}
@@ -272,6 +277,7 @@ def configure(spec=None):
     _locks = "locks" in fams
     _sharding = "sharding" in fams
     _serving = "serving" in fams
+    _compress = "compress" in fams
     _armed = bool(fams)
     _spec = str(spec) if fams else ""
     if fams:
@@ -290,9 +296,10 @@ def configure(spec=None):
 
 
 def disarm():
-    global _armed, _donation, _locks, _sharding, _serving, _spec, \
-        _opts
-    _armed = _donation = _locks = _sharding = _serving = False
+    global _armed, _donation, _locks, _sharding, _serving, \
+        _compress, _spec, _opts
+    _armed = _donation = _locks = _sharding = _serving = \
+        _compress = False
     _spec = ""
     _opts = {}
     # zero the gauge only if arming ever created it — stat_get/set

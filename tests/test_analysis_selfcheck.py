@@ -49,7 +49,8 @@ def test_sanitizer_selfaudit_runtime_dirs():
     report = Report()
     for sub in ("monitor", os.path.join("incubate", "checkpoint"),
                 "jit", "io", "linalg",
-                os.path.join("inference", "serving")):
+                os.path.join("inference", "serving"),
+                os.path.join("distributed", "compress")):
         for path in iter_target_files(os.path.join(PKG, sub)):
             lint_file(path, report, sanitize=SANITIZE_FAMILIES)
     assert not report.findings, \

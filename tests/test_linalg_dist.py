@@ -288,7 +288,10 @@ def test_cholesky_block_sizes_and_4x2(mesh42):
 def test_cholesky_comm_bytes_match_analytic_volume(mesh24):
     """Per panel: one (nb,nb) 2D broadcast of the diagonal block, one
     (N/px, nb) row broadcast of the panel, one (N/px, nb) all_gather
-    up the column tree."""
+    up the column tree. all_gather prices its FULL payload — the
+    group_size gathered copies, px * the per-rank (N/px, nb) panel =
+    the whole (N, nb) column per panel (the ISSUE-14 list-arg payload
+    fix; broadcast stays the per-rank tensor)."""
     N, nb = 64, 16
     spd = _spd(N)
     A = dla.shard(spd)
@@ -302,7 +305,7 @@ def test_cholesky_comm_bytes_match_analytic_volume(mesh24):
     assert cmon.stat_get("comm/broadcast/bytes") - b0 == \
         t * (nb * nb + rb * nb) * 4
     assert cmon.stat_get("comm/all_gather/bytes") - g0 == \
-        t * rb * nb * 4
+        t * g.px * rb * nb * 4
 
 
 def test_tsqr_matches_reference(mesh24):

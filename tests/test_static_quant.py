@@ -144,6 +144,63 @@ def test_freeze_skips_activation_activation_matmul(static_mode):
     np.testing.assert_allclose(r, (av * 2) @ (av * 2).T, rtol=1e-5)
 
 
+def _eager_vs_executor(main, exe, feed, fetch):
+    """Run the Program through BOTH regimes: Executor (replay inside
+    jax.jit — the to_static path) and _eager_replay (the recorded
+    kernels executed eagerly). A kernel rewrite must read identically
+    through both — XLA fusing the quant arithmetic into the
+    surrounding matmul cannot change the numbers."""
+    from paddle_tpu.quantization.static_quant import _eager_replay
+
+    compiled, = exe.run(main, feed=feed, fetch_list=[fetch])
+    env = _eager_replay(main, feed)
+    eager = np.asarray(env[id(fetch)])
+    return compiled, eager
+
+
+def test_qat_program_eager_vs_to_static_parity(static_mode):
+    """ISSUE-14 satellite: the QAT-rewritten Program produces the
+    same numbers eagerly and compiled (and really changed them vs
+    the unrewritten program)."""
+    main, _, img, label, logits, loss = _mnist_program()
+    exe = static.Executor()
+    xs, ys = _batch(16)
+    feed = {"img": xs, "label": ys}
+    ref, _ = _eager_vs_executor(main, exe, feed, logits)
+    qat = QuantizationTransformPass()
+    qat.apply(main)
+    assert qat.rewritten >= 3
+    compiled, eager = _eager_vs_executor(main, exe, feed, logits)
+    np.testing.assert_allclose(compiled, eager, rtol=1e-4,
+                               atol=1e-4)
+    assert not np.array_equal(compiled, ref)  # rewrite took effect
+
+
+def test_frozen_int8_program_eager_vs_to_static_parity(static_mode):
+    """ISSUE-14 satellite: the frozen weight-only-int8 Program (plus
+    calibrated static activation scales) reads the same through the
+    eager replay and the jit-compiled Executor. The static path
+    re-quantizes ACTIVATIONS with round(); XLA's float reassociation
+    can flip a value sitting exactly on a rounding boundary into the
+    neighboring bin, so agreement is gated at quantization-step
+    scale (plus exact class agreement) — what a real dequant bug
+    (e.g. a double-applied scale, ~127x off) can never satisfy."""
+    import jax.numpy as jnp
+
+    main, _, img, label, logits, loss = _mnist_program()
+    exe = static.Executor()
+    xs, ys = _batch(16)
+    feed = {"img": xs, "label": ys}
+    _, freeze = quant_post_static(main, [feed], fetch_list=[logits])
+    assert freeze.frozen >= 3
+    assert any(p._value.dtype == jnp.int8
+               for p in main.all_parameters())
+    compiled, eager = _eager_vs_executor(main, exe, feed, logits)
+    np.testing.assert_allclose(
+        compiled, eager, atol=0.05 * np.abs(eager).max())
+    assert (compiled.argmax(1) == eager.argmax(1)).mean() >= 0.95
+
+
 def test_freeze_shared_weight_quantized_once(static_mode):
     """Review r4: a weight leaf shared by two quantizable ops (tied
     weights) must quantize ONCE with one scale — re-deriving from the
