@@ -564,6 +564,36 @@ def bench_ernie(on_tpu):
                  _mfu(6 * _param_count(model) * batch * seq, dt))
 
 
+def _itl_ms(gaps):
+    """p50/p99 inter-token latency (ms) off raw second-gaps — ONE
+    implementation for the serving config and its resilience twin
+    (ISSUE 15 satellite; previously two ad-hoc sorted-list copies).
+    Routes through monitor.Histogram and ASSERTS the histogram
+    quantiles agree with the sorted-list convention they replaced on
+    the same data, within one log-bucket of resolution — so the
+    Histogram the runtime exports is provably the number the bench
+    used to report."""
+    from paddle_tpu.core.monitor import Histogram
+
+    h = Histogram("bench/itl_us")
+    for g in gaps:
+        h.observe(g * 1e6)
+    sg = sorted(gaps) or [0.0]
+    out = {}
+    for key, q in (("itl_p50_ms", 0.5), ("itl_p99_ms", 0.99)):
+        exact_ms = 1e3 * sg[min(len(sg) - 1, int(len(sg) * q))]
+        hist_ms = h.quantile(q) / 1e3 if gaps else 0.0
+        # one bucket's width of tolerance (plus 10us of float slack
+        # for near-zero CPU-smoke gaps)
+        ratio = 10.0 ** (1.0 / h.per_decade)
+        assert (hist_ms <= exact_ms * ratio + 0.01
+                and hist_ms >= exact_ms / ratio - 0.01), (
+            f"histogram {key} {hist_ms}ms disagrees with sorted-list "
+            f"{exact_ms}ms beyond one bucket ({ratio:.3f}x)")
+        out[key] = round(hist_ms, 3)
+    return out
+
+
 def bench_serving(on_tpu):
     """ISSUE 11: the serving engine under mixed-length generation
     traffic — continuous batching (the LLMEngine default) against a
@@ -614,10 +644,7 @@ def bench_serving(on_tpu):
     cb_tps, gaps, cb_dt = run(static=False)
     sb_tps, _, _ = run(static=True)
     r = _pack(round(cb_tps, 1), "tokens/s", [cb_dt])
-    gaps = sorted(gaps) or [0.0]
-    r["itl_p50_ms"] = round(1e3 * gaps[len(gaps) // 2], 3)
-    r["itl_p99_ms"] = round(1e3 * gaps[min(len(gaps) - 1,
-                                           int(len(gaps) * 0.99))], 3)
+    r.update(_itl_ms(gaps))
     r["static_batching_tokens_s"] = round(sb_tps, 1)
     r["cb_vs_static"] = round(cb_tps / sb_tps, 3) if sb_tps else 0.0
 
@@ -671,16 +698,11 @@ def bench_serving(on_tpu):
         router.shutdown()
     deltas = {k: _cmon.stat_get(k) - base[k] for k in keys}
     storm_tps = storm_total / storm_dt if storm_dt else 0.0
-    storm_gaps = sorted(storm_gaps) or [0.0]
     r["resilience"] = {
         "storm_tokens_s": round(storm_tps, 1),
         "goodput_vs_clean": (round(storm_tps / cb_tps, 3)
                              if cb_tps else 0.0),
-        "itl_p50_ms": round(1e3 * storm_gaps[len(storm_gaps) // 2],
-                            3),
-        "itl_p99_ms": round(
-            1e3 * storm_gaps[min(len(storm_gaps) - 1,
-                                 int(len(storm_gaps) * 0.99))], 3),
+        **_itl_ms(storm_gaps),
         "sheds": sheds,
         "shed_rate": round(sheds / max(1, sheds + len(ids)), 4),
         "failovers": deltas["serve/failovers"],
@@ -991,6 +1013,27 @@ def main():
         srv = results.get("serving")
         if isinstance(srv, dict) and "resilience" in srv:
             results["serve_resilience"] = srv.pop("resilience")
+        # tail-latency trajectories (ISSUE 15): the serving
+        # histograms' full bucket summaries + p50/p95/p99 (ms), so
+        # BENCH rounds carry latency DISTRIBUTIONS, not just
+        # throughput — the serving and resilience configs above both
+        # fed these (TTFT, inter-token, queue-wait, e2e)
+        from paddle_tpu.core.monitor import snapshot_quantile
+
+        results["latency"] = {
+            name: {
+                "count": snap["count"],
+                "p50_ms": round(
+                    snapshot_quantile(snap, 0.5) / 1e3, 3),
+                "p95_ms": round(
+                    snapshot_quantile(snap, 0.95) / 1e3, 3),
+                "p99_ms": round(
+                    snapshot_quantile(snap, 0.99) / 1e3, 3),
+                "hist": snap,
+            }
+            for name, snap in (results["telemetry"].get("hists")
+                               or {}).items()
+            if name.startswith("serve/hist/")}
         # distributed-linalg attribution (ISSUE 12): program counts
         # and bytes processed behind the linalg config's GFLOP/s.
         # linalg/* counters only the dist tier produces; the comm

@@ -6,14 +6,17 @@ TPU-native notes: device-memory counters the reference tracks by
 allocator hooks are read from PJRT memory stats when available."""
 from __future__ import annotations
 
+import math
 import os
 import sys
 import threading
 import time
 
-__all__ = ["StatValue", "StatRegistry", "stat_add", "stat_get",
-           "stat_set", "stat_reset", "registry", "VLOG", "vlog_level",
-           "device_memory_stats", "device_memory_in_use"]
+__all__ = ["StatValue", "StatRegistry", "Histogram", "stat_add",
+           "stat_get", "stat_set", "stat_reset", "hist_observe",
+           "hist_get", "snapshot_quantile", "registry", "VLOG",
+           "vlog_level", "device_memory_stats",
+           "device_memory_in_use"]
 
 
 class StatValue:
@@ -56,9 +59,239 @@ class StatValue:
             return self._v
 
 
+# ONE home for the env-knob parsers (the PR-13 dedup discipline):
+# monitor.flight aliases these — core.monitor cannot import the
+# monitor package, so the shared copy lives here at the bottom of
+# the import graph.
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class Histogram:
+    """Thread-safe, mergeable value distribution over FIXED log-spaced
+    bucket boundaries (ISSUE 15 — the latency axis the int counters
+    cannot carry: p50/p99/p999 of step time, inter-token latency,
+    compile time).
+
+    Bucket i (1-based) covers (lo*10^((i-1)/per_decade),
+    lo*10^(i/per_decade)]; bucket 0 is the underflow bin (values <=
+    lo, including <= 0) and the last bucket catches overflow. The
+    boundaries are a pure function of (lo, per_decade, decades), so
+    two histograms built with the same config — in different threads,
+    processes or ranks — merge by adding bucket counts
+    (associatively; the fleet aggregator relies on this). Defaults
+    are tuned for microsecond latencies (1 us .. 1e9 us = ~17 min)
+    at ~12% bucket resolution; PADDLE_MONITOR_HIST_LO /
+    _PER_DECADE / _DECADES override process-wide.
+
+    `quantile(q)` ranks like the sorted-list convention
+    `sorted(v)[min(n-1, int(n*q))]` and log-interpolates inside the
+    winning bucket, clamped to the exact observed [min, max] — so
+    histogram-derived p50/p99 agree with sorted-list math to within
+    one bucket's resolution (bench.py asserts this on live data).
+    Exact sum/count/min/max ride alongside the buckets; snapshot()
+    is taken under the lock, so a concurrent reader can never see a
+    torn view (sum of buckets != count)."""
+
+    __slots__ = ("name", "lo", "per_decade", "decades", "_nb",
+                 "_counts", "_count", "_sum", "_min", "_max", "_lock")
+
+    def __init__(self, name="", lo=None, per_decade=None,
+                 decades=None):
+        self.name = name
+        self.lo = float(lo if lo is not None else
+                        _env_float("PADDLE_MONITOR_HIST_LO", 1.0))
+        self.per_decade = max(1, int(
+            per_decade if per_decade is not None else
+            _env_int("PADDLE_MONITOR_HIST_PER_DECADE", 20)))
+        self.decades = max(1, int(
+            decades if decades is not None else
+            _env_int("PADDLE_MONITOR_HIST_DECADES", 9)))
+        if self.lo <= 0:
+            raise ValueError(f"histogram lo must be > 0, got {self.lo}")
+        self._nb = self.per_decade * self.decades
+        self._counts = [0] * (self._nb + 2)  # [under, b1..bn, over]
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def _bounds(self):
+        return (self.lo, self.per_decade, self.decades)
+
+    def _edge(self, i):
+        """Upper boundary of bucket i (i=0 -> lo itself)."""
+        return self.lo * 10.0 ** (i / self.per_decade)
+
+    def _index(self, v):
+        if v <= self.lo:
+            return 0
+        i = int(math.log10(v / self.lo) * self.per_decade)
+        # float round-down at an exact edge: log10 can land a hair
+        # under the integer — the half-open (lower, upper] contract
+        # only needs v <= upper, which `int()+1` preserves either way
+        return min(self._nb + 1, i + 1)
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._counts[self._index(v)] += 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * (self._nb + 2)
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
+
+    def merge(self, other):
+        """Fold `other`'s observations into self (bucket-add). Both
+        histograms must share bucket boundaries — merging across
+        configs would silently mislabel every count."""
+        if isinstance(other, Histogram):
+            with other._lock:
+                osnap = (other._bounds(), list(other._counts),
+                         other._count, other._sum, other._min,
+                         other._max)
+        else:  # snapshot dict (cross-process / fleet merge)
+            osnap = (_snap_bounds(other), _snap_counts(other),
+                     int(other.get("count", 0)),
+                     float(other.get("sum", 0.0)),
+                     _snap_min(other), _snap_max(other))
+        bounds, counts, cnt, tot, mn, mx = osnap
+        if bounds != self._bounds():
+            raise ValueError(
+                f"cannot merge histograms with different bucket "
+                f"boundaries: {bounds} vs {self._bounds()}")
+        with self._lock:
+            for i, c in enumerate(counts):
+                if c:
+                    self._counts[i] += c
+            self._count += cnt
+            self._sum += tot
+            if mn < self._min:
+                self._min = mn
+            if mx > self._max:
+                self._max = mx
+        return self
+
+    def quantile(self, q):
+        """Approximate q-quantile (0 <= q <= 1): the sorted-list rank
+        `min(n-1, int(n*q))`, log-interpolated within its bucket and
+        clamped to the observed [min, max]. 0.0 when empty."""
+        with self._lock:
+            return _quantile_locked(
+                self._counts, self._count, self._min, self._max,
+                self.lo, self.per_decade, q)
+
+    def snapshot(self):
+        """Consistent JSON-ready copy: exact count/sum/min/max plus
+        the non-zero buckets (sparse {index: count}), taken under the
+        lock so sum(buckets) == count always holds."""
+        with self._lock:
+            buckets = {i: c for i, c in enumerate(self._counts) if c}
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": None if self._count == 0 else self._min,
+                "max": None if self._count == 0 else self._max,
+                "lo": self.lo,
+                "per_decade": self.per_decade,
+                "decades": self.decades,
+                "buckets": buckets,
+            }
+
+
+def _snap_bounds(snap):
+    return (float(snap["lo"]), int(snap["per_decade"]),
+            int(snap["decades"]))
+
+
+def _snap_counts(snap):
+    nb = int(snap["per_decade"]) * int(snap["decades"])
+    counts = [0] * (nb + 2)
+    for k, c in (snap.get("buckets") or {}).items():
+        counts[int(k)] = int(c)  # JSON round-trips keys as strings
+    return counts
+
+
+def _snap_min(snap):
+    v = snap.get("min")
+    return math.inf if v is None else float(v)
+
+
+def _snap_max(snap):
+    v = snap.get("max")
+    return -math.inf if v is None else float(v)
+
+
+def _quantile_locked(counts, count, vmin, vmax, lo, per_decade, q):
+    if count <= 0:
+        return 0.0
+    q = min(1.0, max(0.0, float(q)))
+    nb = len(counts) - 2
+    # rank matches sorted(v)[min(n-1, int(n*q))] (1-based rank)
+    target = min(count, int(count * q) + 1)
+    cum = 0
+    for idx, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= target:
+            if idx == 0:            # underflow: everything <= lo
+                return vmin
+            if idx == nb + 1:       # overflow
+                return vmax
+            lower = lo * 10.0 ** ((idx - 1) / per_decade)
+            upper = lo * 10.0 ** (idx / per_decade)
+            frac = (target - cum) / c
+            val = lower * (upper / lower) ** frac
+            return min(max(val, vmin), vmax)
+        cum += c
+    return vmax
+
+
+def snapshot_quantile(snap, q):
+    """quantile(q) over a Histogram.snapshot() dict — the offline
+    flavor the fleet aggregator and bench extra.latency use on
+    spooled (JSON round-tripped) histograms."""
+    return _quantile_locked(
+        _snap_counts(snap), int(snap.get("count", 0)),
+        _snap_min(snap), _snap_max(snap), float(snap["lo"]),
+        int(snap["per_decade"]), q)
+
+
 class StatRegistry:
     def __init__(self):
         self._stats = {}
+        self._hists = {}
         self._lock = threading.Lock()
 
     def get(self, name) -> StatValue:
@@ -67,6 +300,16 @@ class StatRegistry:
                 self._stats[name] = StatValue(name)
             return self._stats[name]
 
+    def histogram(self, name, **kwargs) -> Histogram:
+        """Get-or-create the named Histogram (kept BESIDE the int
+        stats: snapshot() stays a flat {name: int} map for every
+        existing consumer; histogram summaries travel separately via
+        snapshot_histograms() / telemetry_snapshot()["hists"])."""
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = Histogram(name, **kwargs)
+            return self._hists[name]
+
     def snapshot(self):
         """Consistent point-in-time copy of every stat, taken under the
         registry lock (the exporter's read path)."""
@@ -74,14 +317,25 @@ class StatRegistry:
             stats = list(self._stats.items())
         return {k: v.get() for k, v in stats}
 
+    def snapshot_histograms(self):
+        """{name: Histogram.snapshot()} for every registered
+        histogram — each snapshot internally consistent (taken under
+        its histogram's lock)."""
+        with self._lock:
+            hists = list(self._hists.items())
+        return {k: h.snapshot() for k, h in hists}
+
     def reset_all(self):
         """Zero every registered stat, holding the registry lock while
         collecting the stat list (stat_reset(None) previously iterated
         `_stats` unlocked and could miss/clash with concurrent get())."""
         with self._lock:
             stats = list(self._stats.values())
+            hists = list(self._hists.values())
         for v in stats:
             v.reset()
+        for h in hists:
+            h.reset()
 
     def all(self):
         return self.snapshot()
@@ -109,6 +363,16 @@ def stat_reset(name=None):
         registry.reset_all()
     else:
         registry.get(name).reset()
+
+
+def hist_observe(name, value):
+    """One observation into the named process-wide Histogram (the
+    STAT_ADD analog for distributions)."""
+    registry.histogram(name).observe(value)
+
+
+def hist_get(name) -> Histogram:
+    return registry.histogram(name)
 
 
 def device_memory_stats(device=None):
@@ -151,9 +415,33 @@ def vlog_level():
         return env
 
 
+def _vlog_rank():
+    """(world_size, rank) via the side-effect-free distributed.env
+    peeks, with a total env fallback — VLOG must work (and never
+    initialize a jax backend) even when the distributed package is
+    half-imported or broken."""
+    try:
+        from ..distributed.env import peek_rank, peek_world_size
+
+        return int(peek_world_size()), int(peek_rank())
+    except Exception:
+        try:
+            return (int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                    int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        except ValueError:
+            return 1, 0
+
+
 def VLOG(level, *msg):
     """glog VLOG(level) << ... analog; enabled by GLOG_v env or
-    FLAGS_v."""
+    FLAGS_v. Multi-rank runs (world size > 1) put the rank in the
+    prefix — `V<level> r<rank> HH:MM:SS]` — so N ranks' interleaved
+    stderr stays attributable; single-rank output is byte-identical
+    to the rank-less form (ISSUE 15 satellite)."""
     if level <= vlog_level():
         ts = time.strftime("%H:%M:%S")
-        print(f"V{level} {ts}]", *msg, file=sys.stderr)
+        world, rank = _vlog_rank()
+        if world > 1:
+            print(f"V{level} r{rank} {ts}]", *msg, file=sys.stderr)
+        else:
+            print(f"V{level} {ts}]", *msg, file=sys.stderr)

@@ -199,9 +199,12 @@ def _instrumented(op):
                 # permanent hang to the watchdog
                 _flight.end(tok)
             _monitor.stat_add(f"comm/{op}/calls", 1)
-            _monitor.stat_add(
-                f"comm/{op}/host_us",
-                int((_time.perf_counter() - t0) * 1e6))
+            host_us = int((_time.perf_counter() - t0) * 1e6)
+            _monitor.stat_add(f"comm/{op}/host_us", host_us)
+            # one host-side latency distribution over ALL collective
+            # ops (ISSUE 15) — the straggler follow-up question
+            # ("slow rank: is it comm?") reads p99 here
+            _monitor.hist_observe("comm/hist/host_us", host_us)
             if nbytes:
                 _monitor.stat_add(f"comm/{op}/bytes", nbytes)
                 # wire payload: what actually crosses the links at

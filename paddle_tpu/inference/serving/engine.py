@@ -71,6 +71,7 @@ import numpy as np
 from ...core import monitor as _cmon
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import trace as _trace
 from . import model_runner as _mr
 from .kv_cache import NULL_BLOCK, PagedKVCache, env_max_batch
 from .scheduler import (EngineOverloaded, EXPORTED, FINISHED,
@@ -324,8 +325,13 @@ class LLMEngine:
                 np.float32(s.temperature), np.int32(s.top_k),
                 np.uint32(_mr.seed_for(s.seed, plen)))
             tok = int(tok)
-        _cmon.stat_add("serve/prefill_us",
-                       int((time.perf_counter() - t0) * 1e6))
+        dur_us = int((time.perf_counter() - t0) * 1e6)
+        _cmon.stat_add("serve/prefill_us", dur_us)
+        if _trace._armed:
+            # replayed > 0 marks an eviction-recompute or a failover/
+            # drain replay leg (the preserved output_ids re-prefill)
+            _trace.note(req, "prefill", tokens=plen, dur_us=dur_us,
+                        replayed=len(req.output_ids))
         self.heartbeat = time.monotonic()
         return tok
 
@@ -463,10 +469,23 @@ class LLMEngine:
 
     # -- token emission / stop conditions ----------------------------
     def _emit(self, req, token, emitted):
+        now = time.perf_counter()
         req.output_ids.append(token)
-        req.token_times.append(time.perf_counter())
+        req.token_times.append(now)
         emitted[req.req_id] = token
         _cmon.stat_add("serve/tokens", 1)
+        # latency distributions off the token_times stream (ISSUE
+        # 15): first token -> TTFT from this engine leg's arrival;
+        # later tokens -> the inter-token gap a streaming client sees
+        if len(req.token_times) == 1:
+            _cmon.hist_observe("serve/hist/ttft_us",
+                               (now - req.arrival_perf) * 1e6)
+        else:
+            _cmon.hist_observe(
+                "serve/hist/itl_us",
+                (now - req.token_times[-2]) * 1e6)
+        if _trace._armed:
+            _trace.note(req, "decode", n=len(req.output_ids))
         if req.on_token is not None:
             try:
                 req.on_token(req.req_id, token)
@@ -540,6 +559,11 @@ class LLMEngine:
             "sampling": req.sampling,
             "deadline": req.deadline,
             "evictions": req.evictions,
+            # trace continuity (ISSUE 15): the importing engine keeps
+            # the SAME trace_id and the timeline-so-far, so a
+            # replayed request's story reads export -> import ->
+            # replay in one place
+            "trace_id": req.trace_id,
         }
 
     def export_requests(self, fence=True):
@@ -561,8 +585,12 @@ class LLMEngine:
         exports = []
         for req in live:
             req.on_token = None   # zombie emits must not stream
-            exports.append(self._export(req))
+            exp = self._export(req)
             sched.finish(req, state=EXPORTED)
+            # snapshot the timeline AFTER finish so the export
+            # carries its own "exported" terminal event
+            exp["trace"] = list(req.trace)
+            exports.append(exp)
         return exports
 
     def import_request(self, export, on_token=None, force=False):
@@ -577,10 +605,19 @@ class LLMEngine:
         req = Request(export["prompt_ids"],
                       sampling=export["sampling"],
                       on_token=on_token,
-                      req_id=export["req_id"])
+                      req_id=export["req_id"],
+                      trace_id=export.get("trace_id"))
         req.output_ids = list(export["output_ids"])
         req.deadline = export.get("deadline")
         req.evictions = int(export.get("evictions", 0))
+        if export.get("trace"):
+            # continue the exporting engine's timeline (same
+            # trace_id) — the ctor's fresh "add" event is replaced by
+            # the full story plus this import leg
+            req.trace = list(export["trace"])
+        if _trace._armed:
+            _trace.note(req, "import", replayed=len(req.output_ids),
+                        forced=bool(force))
         self.scheduler.add(req, force=force)
         self._requests[req.req_id] = req
         return req.req_id
@@ -664,6 +701,22 @@ class LLMEngine:
         _cmon.stat_add("serve/drains", 1)
         _flight.record("serve_drain_done", exported=len(exports),
                        emergency=True, reason=str(reason))
+
+    # -- trace spool (ISSUE 15) --------------------------------------
+    def export_traces(self):
+        """Trace spool (schema "paddle_tpu.trace/1") over every
+        retained request's per-stage timeline — the input
+        `python -m paddle_tpu.monitor trace` renders to a
+        chrome-trace. Live requests show their story so far."""
+        return _trace.export_requests(self._requests.values())
+
+    def dump_traces(self, path):
+        """Write export_traces() as JSON; returns the path."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.export_traces(), f, default=str)
+        return path
 
     # -- accounting --------------------------------------------------
     def check_drained(self):

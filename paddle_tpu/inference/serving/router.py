@@ -62,6 +62,7 @@ import time
 from ...core import monitor as _cmon
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import trace as _trace
 from .engine import EngineTimeout, LLMEngine
 from .scheduler import EngineOverloaded
 
@@ -271,6 +272,8 @@ class Router:
                 rec = _Record(rid, on_token, rep.idx,
                               rep.engine.get_request(rid))
                 self._records[rid] = rec
+                if _trace._armed:
+                    _trace.note(rec.req, "route", replica=rep.idx)
                 # reset the wedge clock ONLY on the idle->work
                 # transition (an engine idle for an hour is not
                 # wedged the moment work lands) — a busy replica
@@ -371,6 +374,11 @@ class Router:
                 if rec is not None:
                     rec.replica = target.idx
                     rec.req = target.engine.get_request(rid)
+                if _trace._armed:
+                    _trace.note(target.engine.get_request(rid),
+                                "failover", from_replica=rep.idx,
+                                to_replica=target.idx,
+                                reason=str(reason)[:80])
                 if was_idle:     # idle->work only, as in submit()
                     target.engine.heartbeat = time.monotonic()
                 target.work.set()
@@ -508,6 +516,30 @@ class Router:
                 rep.thread.join(timeout=timeout_s)
         for rep in self._replicas:
             rep.engine.disarm_incident_export()
+
+    # -- trace spool (ISSUE 15) --------------------------------------
+    def export_traces(self):
+        """Fleet-wide trace spool: every replica's retained requests,
+        each entry tagged with its replica index. A failed-over
+        request appears once per engine leg (same trace_id) — the
+        exporting replica's story up to EXPORTED plus the survivor's
+        import-and-replay continuation."""
+        entries = []
+        for rep in self._replicas:
+            spool = _trace.export_requests(
+                rep.engine._requests.values(),
+                extra={"replica": rep.idx})
+            entries.extend(spool["requests"])
+        out = _trace.export_requests(())
+        out["requests"] = entries
+        return out
+
+    def dump_traces(self, path):
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.export_traces(), f, default=str)
+        return path
 
     # -- introspection -----------------------------------------------
     def replica_healthy(self, idx):

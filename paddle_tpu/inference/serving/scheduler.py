@@ -66,6 +66,7 @@ from collections import deque
 from ...core import monitor as _cmon
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import trace as _trace
 
 __all__ = ["SamplingParams", "Request", "Scheduler",
            "EngineOverloaded", "env_max_queue", "env_deadline_s",
@@ -192,7 +193,7 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, sampling=None, on_token=None,
-                 req_id=None):
+                 req_id=None, trace_id=None):
         self.req_id = (f"req-{next(Request._ids)}"
                        if req_id is None else str(req_id))
         self.prompt_ids = [int(t) for t in prompt_ids]
@@ -206,10 +207,26 @@ class Request:
         self.evictions = 0
         self.token_times = []      # perf_counter per emitted token
         self.arrival = time.monotonic()
+        # TTFT/e2e latency anchor on the SAME clock as token_times
+        # (perf_counter); `arrival` stays the monotonic deadline/
+        # queue-wait clock — mixing the two would skew every gap
+        self.arrival_perf = time.perf_counter()
         # absolute expiry (monotonic); None = no SLO. Survives
         # eviction/export so a replayed request keeps its budget.
         self.deadline = (self.arrival + self.sampling.deadline_s
                          if self.sampling.deadline_s else None)
+        # -- per-request trace (ISSUE 15): trace_id minted at intake
+        # (add_request/submit construct the Request there) and kept
+        # through eviction/export/import-replay; `trace` is the
+        # bounded stage timeline monitor.trace.note() appends to
+        self.trace = []
+        self.trace_dropped = 0
+        self.trace_id = (trace_id if trace_id is not None
+                         else (_trace.mint() if _trace._armed
+                               else None))
+        if _trace._armed:
+            _trace.note(self, "add", prompt=len(self.prompt_ids))
+        self._queue_waited = False  # first-admission wait observed
 
     @property
     def priority(self):
@@ -371,8 +388,19 @@ class Scheduler:
             self.running[req.slot] = req
             self._admitted_at[req.req_id] = next(self._admit_seq)
             admitted.append(req)
+            if not req._queue_waited:
+                # queue-wait distribution (ISSUE 15): arrival ->
+                # FIRST admission only — an eviction's re-admission
+                # wait is recompute churn, not intake queueing
+                req._queue_waited = True
+                _cmon.hist_observe(
+                    "serve/hist/queue_wait_us",
+                    (time.monotonic() - req.arrival) * 1e6)
             _flight.record("serve_admit", req=req.req_id,
                            slot=req.slot, blocks=nblocks)
+            if _trace._armed:
+                _trace.note(req, "admit", slot=req.slot,
+                            blocks=nblocks, readmit=req.evictions)
             if on_admit is not None:
                 on_admit(req)
         self._sync_depth()
@@ -427,6 +455,10 @@ class Scheduler:
         _cmon.stat_add("serve/evictions", 1)
         _flight.record("serve_evict", req=request.req_id,
                        evictions=request.evictions)
+        if _trace._armed:
+            _trace.note(request, "evict",
+                        evictions=request.evictions,
+                        kept_tokens=len(request.output_ids))
 
     # -- completion --------------------------------------------------
     def finish(self, request, state=FINISHED):
@@ -448,8 +480,19 @@ class Scheduler:
             self._sync_depth()
         self.cache.allocator.release(request.req_id)
         self._admitted_at.pop(request.req_id, None)
+        if state == FINISHED:
+            # e2e request latency (ISSUE 15): arrival at THIS engine
+            # -> completion, on the token_times clock. A failover
+            # replay re-anchors at import (each engine leg is its own
+            # observation; the trace timeline carries the whole story)
+            _cmon.hist_observe(
+                "serve/hist/e2e_us",
+                (time.perf_counter() - request.arrival_perf) * 1e6)
         _flight.record("serve_finish", req=request.req_id,
                        tokens=len(request.output_ids), state=state)
+        if _trace._armed:
+            _trace.note(request, state,
+                        tokens=len(request.output_ids))
 
     def abort(self, request):
         """Cancel wherever it is; blocks release immediately and a

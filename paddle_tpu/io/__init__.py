@@ -720,6 +720,9 @@ class DataLoader:
             us = int((_time.perf_counter() - t0) * 1e6)
             _monitor.stat_add("io/batches", 1)
             _monitor.stat_add("io/fetch_us", us)
+            # the fetch DISTRIBUTION (ISSUE 15): a p99 fetch stall
+            # hides inside the cumulative io/fetch_us counter
+            _monitor.hist_observe("io/hist/fetch_us", us)
             _flight.record("io_fetch", n=len(indices), us=us)
         return batch
 

@@ -421,9 +421,15 @@ class StaticFunction:
             if compile_ev is not None:
                 compile_ev.end()
                 _flight.end(compile_tok)
-                _monitor.stat_add(
-                    f"jit/{fname}/compile_us",
-                    int((_time.perf_counter() - t_compile0) * 1e6))
+                compile_us = int(
+                    (_time.perf_counter() - t_compile0) * 1e6)
+                _monitor.stat_add(f"jit/{fname}/compile_us",
+                                  compile_us)
+                # ONE compile-time distribution across every jitted
+                # fn (ISSUE 15) — the per-fn counters fan out too
+                # wide to read a fleet p99 from
+                _monitor.hist_observe("jit/hist/compile_us",
+                                      compile_us)
                 # footprint capture only AFTER the first successful
                 # execution: capturing at build time would run the
                 # function's first-ever trace, and a user-code raise
@@ -988,9 +994,10 @@ class TrainStepCompiler:
                     self._load_persistent(trainable, frozen, bufs,
                                           batch)
                 out = self._run_compiled(trainable, frozen, bufs, batch)
-            _monitor.stat_add(
-                "jit/train_step/compile_us",
-                int((_time.perf_counter() - t0) * 1e6))
+            compile_us = int((_time.perf_counter() - t0) * 1e6)
+            _monitor.stat_add("jit/train_step/compile_us",
+                              compile_us)
+            _monitor.hist_observe("jit/hist/compile_us", compile_us)
             self._capture_memory(batch)
             return out
         _monitor.stat_add("jit/train_step/cache_hit", 1)

@@ -56,22 +56,25 @@ import threading
 import time
 
 from ..core.monitor import (  # noqa: F401 — the counter surface
-    StatValue, StatRegistry, registry, stat_add, stat_get, stat_set,
-    stat_reset, VLOG, vlog_level, device_memory_stats,
-    device_memory_in_use,
+    StatValue, StatRegistry, Histogram, registry, stat_add, stat_get,
+    stat_set, stat_reset, hist_observe, hist_get, snapshot_quantile,
+    VLOG, vlog_level, device_memory_stats, device_memory_in_use,
 )
 from . import flight  # noqa: E402 — the failure-forensics leg
 from . import memory  # noqa: E402 — the device-memory leg
 from . import chaos  # noqa: E402 — deterministic fault injection
 from . import sanitize  # noqa: E402 — runtime sanitizer core (ISSUE 10)
+from . import trace  # noqa: E402 — per-request serving traces (ISSUE 15)
+from . import fleet  # noqa: E402 — fleet aggregation + stragglers
 
 __all__ = [
-    "StatValue", "StatRegistry", "registry", "stat_add", "stat_get",
-    "stat_set", "stat_reset", "VLOG", "vlog_level",
+    "StatValue", "StatRegistry", "Histogram", "registry", "stat_add",
+    "stat_get", "stat_set", "stat_reset", "hist_observe", "hist_get",
+    "snapshot_quantile", "VLOG", "vlog_level",
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
-    "get_exporter", "telemetry_snapshot", "flight", "memory",
-    "chaos",
+    "get_exporter", "telemetry_snapshot", "fleet_snapshot", "flight",
+    "memory", "chaos", "trace", "fleet",
 ]
 
 
@@ -92,7 +95,20 @@ def telemetry_snapshot():
     except Exception:
         pass
     return {"ts": round(time.time(), 3), "rank": _rank(),
-            "stats": registry.snapshot()}
+            "stats": registry.snapshot(),
+            # histogram summaries travel BESIDE the flat int stats
+            # (ISSUE 15): sparse bucket maps + exact sum/count/min/max
+            # per Histogram, each internally consistent
+            "hists": registry.snapshot_histograms()}
+
+
+def fleet_snapshot(timeout=60.0):
+    """Live fleet-wide merge of every rank's telemetry_snapshot() over
+    the rank-0 KV-store bootstrap (see monitor/fleet.py): rank 0
+    returns the merged view (counters summed, gauges per-rank,
+    histograms bucket-merged, stragglers flagged), other ranks return
+    None. Single-process: the local snapshot as a one-rank view."""
+    return fleet.fleet_snapshot(timeout=timeout)
 
 
 # ONE copy of the launch-env rank parsing, shared with the dump
@@ -140,6 +156,10 @@ class StepTimer:
         stat_add("step/count", 1)
         stat_add("step/total_time_us", int(dt * 1e6))
         stat_set("step/last_time_us", int(dt * 1e6))
+        # the step-time DISTRIBUTION (ISSUE 15): p50/p99 step time is
+        # what the fleet straggler detector compares across ranks —
+        # the int gauges above only carry last/total
+        hist_observe("step/hist/time_us", dt * 1e6)
         throughput = None
         if batch_size:
             stat_add("step/samples", int(batch_size))
@@ -247,6 +267,44 @@ def _prom_lines(items):
     return lines
 
 
+def _prom_hist_lines(hists):
+    """Prometheus histogram exposition for {name: Histogram.snapshot()}
+    — the classic `<name>_bucket{le=...}` cumulative series plus
+    `_sum`/`_count`, one `le` per OCCUPIED bucket's upper edge (sparse
+    inputs stay sparse on the wire; cumulative semantics make skipped
+    empty buckets exactly equivalent) with the mandatory `+Inf`
+    terminal. Overflow observations only appear in `+Inf`, as they
+    exceed every finite boundary."""
+    import hashlib
+
+    counts = {}
+    for name in hists:
+        m = _prom_name(name)
+        counts[m] = counts.get(m, 0) + 1
+    lines = []
+    for name in sorted(hists):
+        s = hists[name]
+        m = _prom_name(name)
+        if counts[m] > 1:   # the _prom_lines anti-aliasing discipline
+            m = f"{m}_{hashlib.sha1(name.encode()).hexdigest()[:6]}"
+        lo = float(s["lo"])
+        pd = int(s["per_decade"])
+        nb = pd * int(s["decades"])
+        buckets = sorted((int(k), int(v))
+                         for k, v in (s.get("buckets") or {}).items())
+        cum = 0
+        for idx, c in buckets:
+            cum += c
+            if idx > nb:
+                continue  # overflow folds into +Inf below
+            le = lo * 10.0 ** (idx / pd) if idx else lo
+            lines.append(f'{m}_bucket{{le="{le:.6g}"}} {cum}')
+        lines.append(f'{m}_bucket{{le="+Inf"}} {int(s["count"])}')
+        lines.append(f'{m}_sum {float(s["sum"]):.6g}')
+        lines.append(f'{m}_count {int(s["count"])}')
+    return lines
+
+
 class MetricsExporter:
     """Periodic flush of the StatRegistry snapshot to a file.
 
@@ -294,8 +352,10 @@ class MetricsExporter:
             tmp = f"{path}.tmp.{os.getpid()}"
             items = sorted(snap["stats"].items())
             items.append(("export_timestamp_seconds", snap["ts"]))
+            lines = _prom_lines(items)
+            lines += _prom_hist_lines(snap.get("hists") or {})
             with open(tmp, "w") as f:
-                f.write("\n".join(_prom_lines(items)) + "\n")
+                f.write("\n".join(lines) + "\n")
             os.replace(tmp, path)
         return snap
 

@@ -28,6 +28,23 @@ artifacts the runtime leaves behind:
       PADDLE_CHAOS spec (the positional spec, else $PADDLE_CHAOS):
       prints the parsed rules, or an `error: ...` + exit 2 on an
       invalid spec — run it before launching a chaos job.
+
+  trace spool.json ... [-o chrome.json] [--pid-stride N]
+      Render per-request serving trace spools (engine/router
+      `dump_traces()` output, schema "paddle_tpu.trace/1") to ONE
+      chrome trace with the merge-traces pid layout (rank r -> pid
+      r*stride + 1, one tid per request) — serving timelines land
+      beside merged profiler traces in a single Perfetto view.
+      Without -o, prints each request's stage-by-stage timeline
+      (the queue-wait / recompute / replay attribution) as text.
+
+  fleet rank0.jsonl rank1.json ... [--json] [--threshold X]
+      Merge per-rank telemetry artifacts (exporter .jsonl trails,
+      flight dump bundles, raw telemetry snapshots) into one fleet
+      view — counters summed, gauges per-rank, histograms
+      bucket-merged with fleet p50/p99 — and flag stragglers
+      (per-rank mean step time vs the fleet median, the slowest
+      rank attributed with its longest flight spans).
 """
 from __future__ import annotations
 
@@ -443,13 +460,158 @@ def cmd_tail(args):
 
 
 # ---------------------------------------------------------------------------
+# trace (serving trace spools -> chrome trace / text timeline)
+# ---------------------------------------------------------------------------
+
+def cmd_trace(args):
+    from . import trace as trace_mod
+
+    spools = []
+    for pos, path in enumerate(args.spools):
+        with open(path) as f:
+            spool = json.load(f)
+        if not isinstance(spool, dict) or "requests" not in spool:
+            print(f"trace: {path}: not a trace spool "
+                  f"(expected schema {trace_mod.TRACE_SCHEMA})",
+                  file=sys.stderr)
+            return 1
+        # filename rankN token overrides the recorded rank (replica
+        # spools all record rank 0 in single-host tests; distinct
+        # tokens keep their pid spaces disjoint in the merged view)
+        m = _RANK_RE.search(os.path.basename(path))
+        if m:
+            spool = dict(spool, rank=int(m.group(1)))
+        elif spool.get("rank") is None:
+            spool = dict(spool, rank=pos)
+        spools.append(spool)
+    if args.output:
+        doc = trace_mod.to_chrome(spools, pid_stride=args.pid_stride)
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        nreq = sum(len(s.get("requests") or []) for s in spools)
+        print(f"rendered {len(spools)} spool(s), {nreq} request(s), "
+              f"{len(doc['traceEvents'])} events -> {args.output}")
+        return 0
+    for spool in spools:
+        reqs = spool.get("requests") or []
+        print(f"rank {spool.get('rank')}: {len(reqs)} traced "
+              f"request(s)")
+        for entry in reqs:
+            evs = entry.get("events") or []
+            print(f"\n  {entry.get('req_id')} "
+                  f"[{entry.get('trace_id')}]  "
+                  f"state={entry.get('state')} "
+                  f"tokens={entry.get('tokens')}"
+                  + (f"  dropped={entry['dropped']}"
+                     if entry.get("dropped") else ""))
+            for i, ev in enumerate(evs):
+                gap_ms = ((float(evs[i + 1]["ts"]) - float(ev["ts"]))
+                          * 1e3 if i + 1 < len(evs) else None)
+                extra = " ".join(
+                    f"{k}={v}" for k, v in ev.items()
+                    if k not in ("ts", "stage"))
+                print(f"    {_fmt_ts(ev.get('ts'))}  "
+                      f"{str(ev.get('stage', '?')):<12s}"
+                      + (f" +{gap_ms:8.1f}ms" if gap_ms is not None
+                         else " " * 11)
+                      + (f"  {extra}" if extra else ""))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# fleet (multi-rank telemetry merge + straggler report)
+# ---------------------------------------------------------------------------
+
+def cmd_fleet(args):
+    from . import fleet as fleet_mod
+    from ..core.monitor import snapshot_quantile
+
+    view = fleet_mod.fleet_view(args.artifacts,
+                                threshold=args.threshold)
+    if args.json:
+        json.dump(view, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+        return 0
+    out = [f"fleet view over ranks {view['ranks']} "
+           f"({len(view['sources'])} artifact(s))"]
+    counters = view.get("counters") or {}
+    keys = sorted(k for k in counters
+                  if args.all or k.startswith(
+                      ("step/", "serve/", "comm/", "io/", "jit/")))
+    if keys:
+        out.append("")
+        out.append(f"counters (summed over ranks; {len(counters)} "
+                   "total):")
+        for k in keys:
+            out.append(f"  {k} = {counters[k]}")
+    gauges = view.get("gauges") or {}
+    gkeys = sorted(k for k in gauges
+                   if args.all or k.startswith(
+                       ("step/", "serve/", "mem/")))
+    if gkeys:
+        out.append("")
+        out.append(f"gauges (per-rank — never summed; {len(gauges)} "
+                   "total):")
+        for k in gkeys:
+            out.append("  " + k + "  " + "  ".join(
+                f"r{r}={v}" for r, v in sorted(
+                    gauges[k].items(), key=lambda kv: int(kv[0]))))
+    hists = view.get("hists") or {}
+    if hists:
+        out.append("")
+        out.append("histograms (bucket-merged):")
+        for k in sorted(hists):
+            s = hists[k]
+            per_rank = s.get("rank_counts") or {}
+            out.append(
+                f"  {k}: n={s['count']}  "
+                f"p50={snapshot_quantile(s, 0.5):.1f}  "
+                f"p95={snapshot_quantile(s, 0.95):.1f}  "
+                f"p99={snapshot_quantile(s, 0.99):.1f}  "
+                "(per-rank n: "
+                + ", ".join(f"r{r}={n}"
+                            for r, n in sorted(per_rank.items()))
+                + ")")
+    strag = view.get("stragglers") or {}
+    out.append("")
+    step_ms = strag.get("step_ms") or {}
+    if step_ms:
+        out.append(f"step time per rank (median "
+                   f"{strag.get('median_ms')}ms, straggler threshold "
+                   f"{strag.get('threshold')}x):")
+        for r in sorted(step_ms, key=int):
+            out.append(f"  rank {r}: {step_ms[r]}ms")
+        flagged = strag.get("stragglers") or []
+        if flagged:
+            for s in flagged:
+                out.append(
+                    f"  STRAGGLER rank {s['rank']}: "
+                    f"{s['step_ms']}ms = {s['skew']}x median")
+                for sp in s.get("top_spans") or []:
+                    out.append(
+                        f"    {sp['kind']}"
+                        + (f"/{sp['name']}" if sp.get("name") else "")
+                        + f"  {sp['dur_us']}us")
+        else:
+            out.append("  no stragglers flagged")
+    else:
+        out.append("no step/count in any artifact — straggler "
+                   "detection needs step telemetry")
+    print("\n".join(out))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.monitor",
-        description="Failure-forensics CLI: inspect flight dump "
-                    "bundles, merge per-rank chrome traces, summarize "
-                    "exporter metrics trails, report live memory.")
+        description="Failure-forensics + observability CLI: inspect "
+                    "flight dump bundles, merge per-rank chrome "
+                    "traces, summarize exporter metrics trails, "
+                    "report live memory, render per-request serving "
+                    "traces, and merge fleet telemetry with "
+                    "straggler detection.")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pi = sub.add_parser(
@@ -505,6 +667,40 @@ def main(argv=None):
                      help="emit sites/faults/params + parsed rules as "
                           "JSON")
     pch.set_defaults(fn=cmd_chaos)
+
+    ptr = sub.add_parser(
+        "trace",
+        help="render serving trace spools to a chrome trace / text "
+             "timeline")
+    ptr.add_argument("spools", nargs="+",
+                     help="trace spool JSONs (engine/router "
+                          "dump_traces output; rank from a rankN "
+                          "filename token, else the recorded rank)")
+    ptr.add_argument("-o", "--output",
+                     help="write a chrome trace here (default: print "
+                          "text timelines)")
+    ptr.add_argument("--pid-stride", type=int, default=100000,
+                     help="pid offset per rank, merge-traces "
+                          "compatible (default 100000)")
+    ptr.set_defaults(fn=cmd_trace)
+
+    pf = sub.add_parser(
+        "fleet",
+        help="merge per-rank telemetry artifacts + straggler report")
+    pf.add_argument("artifacts", nargs="+",
+                    help="exporter .jsonl trails, flight dump "
+                         "bundles, or telemetry snapshot JSONs "
+                         "(one or more ranks each)")
+    pf.add_argument("--json", action="store_true",
+                    help="emit the merged fleet view as JSON")
+    pf.add_argument("--threshold", type=float, default=None,
+                    help="straggler skew threshold vs the fleet "
+                         "median (default "
+                         "PADDLE_MONITOR_STRAGGLER_X=1.25)")
+    pf.add_argument("--all", action="store_true",
+                    help="show every merged counter, not just the "
+                         "step/serve/comm/io/jit families")
+    pf.set_defaults(fn=cmd_fleet)
 
     args = p.parse_args(argv)
     try:

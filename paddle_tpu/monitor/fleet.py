@@ -1,0 +1,310 @@
+"""paddle_tpu.monitor.fleet — fleet-wide telemetry aggregation +
+straggler detection (ISSUE 15).
+
+Multi-rank runs leave one telemetry trail PER RANK (exporter jsonl
+spools, flight dump bundles); nothing merged them, so "which rank is
+slow" was a grep exercise. This module is the merge + skew layer:
+
+  * merge_records() — N per-rank records into ONE fleet view:
+    monotonic COUNTERS sum (`step/count`, `comm/*/bytes`, ...);
+    GAUGES (step/last_*, queue depths, mem/* watermarks — see
+    `is_gauge`) stay per-rank (summing a watermark is a lie);
+    HISTOGRAMS bucket-merge (the Histogram boundaries are a pure
+    function of their config, so per-rank bucket counts add — the
+    fleet p99 is exact over the union of observations).
+  * straggler_report() — per-rank mean step time
+    (`step/total_time_us / step/count`) vs the FLEET MEDIAN; ranks
+    slower than `threshold`× the median (PADDLE_MONITOR_STRAGGLER_X,
+    default 1.25) are flagged, and the slowest rank is attributed
+    with its longest flight spans (`*_end` ring events' dur_us) when
+    the record came from a dump bundle — "rank 3 is 1.8× the median
+    and spent its time in collective/all_reduce" instead of a bare
+    number.
+  * load_spool() / fleet_view() — the offline entry: exporter
+    `.jsonl` trails (last flush per rank) and flight dump bundles
+    both parse into records; `python -m paddle_tpu.monitor fleet`
+    wraps fleet_view().
+  * fleet_snapshot() — the LIVE entry for a running multi-rank job:
+    every rank publishes its telemetry_snapshot() to the rank-0 KV
+    store (the store_collective bootstrap the eager collectives
+    already stand up), rank 0 merges. Collective-style discipline:
+    all ranks must call it the same number of times.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+
+from ..core import monitor as _cmon
+from ..core.monitor import Histogram, snapshot_quantile  # noqa: F401
+from . import flight as _flight
+
+__all__ = ["is_gauge", "merge_hists", "merge_records",
+           "straggler_report", "load_spool", "load_records",
+           "fleet_view", "fleet_snapshot", "top_spans"]
+
+
+# -- counter-vs-gauge classification ---------------------------------------
+# The registry holds both monotonic counters (stat_add) and
+# overwrite/watermark gauges (stat_set/maximum) under one namespace;
+# merging must not sum a watermark. The split is by NAME (the same
+# heuristic a Prometheus relabeling would encode) — kept here, in one
+# place, so the CLI and the live merge agree.
+
+_GAUGE_PREFIXES = ("mem/", "step/mem/", "flight/events",
+                   "flight/ring/", "serve/kv_blocks/",
+                   "chaos/", "sanitize/")
+_GAUGE_SUFFIXES = ("/queue_depth", "/throughput", "/healthy",
+                   "/armed", "/steps_per_dispatch")
+_GAUGE_SUBSTR = ("/last_", "/lr_e9", "last_loss", "last_time")
+
+
+def is_gauge(name):
+    """True for stats whose fleet merge must stay per-rank (gauges,
+    watermarks) rather than summing (counters)."""
+    if name.startswith(_GAUGE_PREFIXES):
+        return True
+    if name.endswith(_GAUGE_SUFFIXES):
+        return True
+    return any(s in name for s in _GAUGE_SUBSTR)
+
+
+def merge_hists(snaps):
+    """Bucket-merge Histogram.snapshot() dicts (all must share
+    boundaries). Returns a merged snapshot dict, or None for no
+    inputs."""
+    snaps = [s for s in snaps if s]
+    if not snaps:
+        return None
+    base = Histogram(lo=float(snaps[0]["lo"]),
+                     per_decade=int(snaps[0]["per_decade"]),
+                     decades=int(snaps[0]["decades"]))
+    for s in snaps:
+        base.merge(s)
+    return base.snapshot()
+
+
+def merge_records(records):
+    """N per-rank records ({"rank", "stats", "hists"}) -> one fleet
+    view: {"ranks", "counters" (summed), "gauges" (per-rank),
+    "hists" (bucket-merged + per-rank counts)}."""
+    records = list(records)
+    ranks = [int(r.get("rank", i)) for i, r in enumerate(records)]
+    counters = {}
+    gauges = {}
+    hist_by_name = {}
+    for rec, rank in zip(records, ranks):
+        for k, v in (rec.get("stats") or {}).items():
+            if is_gauge(k):
+                gauges.setdefault(k, {})[str(rank)] = v
+            else:
+                counters[k] = counters.get(k, 0) + v
+        for k, s in (rec.get("hists") or {}).items():
+            hist_by_name.setdefault(k, []).append((rank, s))
+    hists = {}
+    for k, pairs in hist_by_name.items():
+        merged = merge_hists([s for _, s in pairs])
+        if merged is not None:
+            merged["rank_counts"] = {
+                str(r): int(s.get("count", 0)) for r, s in pairs}
+            hists[k] = merged
+    return {"ranks": sorted(set(ranks)), "counters": counters,
+            "gauges": gauges, "hists": hists}
+
+
+def top_spans(flight_tail, n=5):
+    """Longest completed spans in a flight ring tail: `*_end` events
+    carry dur_us — the attribution payload for a flagged
+    straggler."""
+    spans = [ev for ev in (flight_tail or [])
+             if isinstance(ev, dict)
+             and str(ev.get("kind", "")).endswith("_end")
+             and ev.get("dur_us") is not None]
+    spans.sort(key=lambda e: -int(e["dur_us"]))
+    return [{"kind": ev["kind"][:-4], "name": ev.get("name"),
+             "dur_us": int(ev["dur_us"])} for ev in spans[:n]]
+
+
+def straggler_threshold():
+    """PADDLE_MONITOR_STRAGGLER_X — mean-step-time skew vs the fleet
+    median above which a rank is flagged (default 1.25)."""
+    return max(1.0, _flight._env_float("PADDLE_MONITOR_STRAGGLER_X",
+                                       1.25))
+
+
+def straggler_report(records, threshold=None):
+    """Per-rank mean step time vs the fleet median; ranks above
+    `threshold`x median are stragglers, the slowest gets its top
+    flight spans attached (when its record carries a flight tail —
+    dump-bundle inputs do)."""
+    if threshold is None:
+        threshold = straggler_threshold()
+    step_ms = {}
+    tails = {}
+    for i, rec in enumerate(records):
+        rank = int(rec.get("rank", i))
+        stats = rec.get("stats") or {}
+        n = stats.get("step/count", 0)
+        if n:
+            step_ms[rank] = round(
+                stats.get("step/total_time_us", 0) / n / 1e3, 3)
+        if rec.get("flight_tail"):
+            tails[rank] = rec["flight_tail"]
+    out = {"threshold": threshold,
+           "step_ms": {str(r): v for r, v in sorted(step_ms.items())},
+           "median_ms": None, "stragglers": [], "slowest": None}
+    if not step_ms:
+        return out
+    times = sorted(step_ms.values())
+    # TRUE median (even N averages the middles): the upper-middle
+    # shortcut makes the slow rank of a 2-rank fleet its own
+    # median — skew 1.0, never flagged
+    mid = len(times) // 2
+    median = (times[mid] if len(times) % 2
+              else (times[mid - 1] + times[mid]) / 2.0)
+    out["median_ms"] = median
+    slowest = max(step_ms, key=lambda r: step_ms[r])
+    out["slowest"] = slowest
+    for rank in sorted(step_ms):
+        skew = step_ms[rank] / median if median else 1.0
+        if skew > threshold:
+            entry = {"rank": rank, "step_ms": step_ms[rank],
+                     "skew": round(skew, 3)}
+            if rank in tails:
+                entry["top_spans"] = top_spans(tails[rank])
+            out["stragglers"].append(entry)
+    return out
+
+
+# -- offline loading -------------------------------------------------------
+
+def load_spool(path):
+    """{rank: record} from ONE artifact: a MetricsExporter `.jsonl`
+    trail (last flush per rank wins) or a flight dump bundle (its
+    embedded telemetry + flight tail). Raises ValueError on
+    unparsable input — the CLI's exit-2 contract."""
+    with open(path) as f:
+        text = f.read()
+    out = {}
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and (doc.get("schema") or ""
+                                  ).startswith("paddle_tpu.flight"):
+        tele = doc.get("telemetry") or {}
+        rank = int(doc.get("rank", 0))
+        out[rank] = {"rank": rank,
+                     "stats": tele.get("stats") or {},
+                     "hists": tele.get("hists") or {},
+                     "flight_tail": doc.get("flight_tail"),
+                     "source": path}
+        return out
+    if isinstance(doc, dict) and "stats" in doc:
+        # a single telemetry_snapshot() saved as-is
+        rank = int(doc.get("rank", 0))
+        out[rank] = {"rank": rank, "stats": doc["stats"],
+                     "hists": doc.get("hists") or {}, "source": path}
+        return out
+    bad = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            bad += 1
+            continue
+        if not isinstance(rec, dict) or "stats" not in rec:
+            bad += 1
+            continue
+        rank = int(rec.get("rank", 0))
+        out[rank] = {"rank": rank, "stats": rec["stats"],
+                     "hists": rec.get("hists") or {}, "source": path}
+    if not out:
+        raise ValueError(
+            f"{path}: no exporter records or flight bundle found"
+            + (f" ({bad} unparsable line(s))" if bad else ""))
+    return out
+
+
+def load_records(paths):
+    """Merge load_spool() over many artifacts; a later file's record
+    for the same rank wins (pass newest last)."""
+    ranks = {}
+    for p in paths:
+        ranks.update(load_spool(p))
+    return [ranks[r] for r in sorted(ranks)]
+
+
+def fleet_view(paths, threshold=None):
+    """The `monitor fleet` payload: merged counters/gauges/hists over
+    every rank artifact plus the straggler report."""
+    records = load_records(paths)
+    view = merge_records(records)
+    view["stragglers"] = straggler_report(records,
+                                          threshold=threshold)
+    view["sources"] = [r.get("source") for r in records]
+    return view
+
+
+# -- live fleet snapshot (rank-0 KV store) ---------------------------------
+
+_snap_seq = itertools.count(1)
+
+
+def fleet_snapshot(timeout=60.0):
+    """Live multi-rank merge over the store_collective bootstrap:
+    every rank publishes its telemetry_snapshot() under a
+    per-invocation key; rank 0 polls until all `world_size` records
+    land and returns the merged view (+ stragglers); other ranks
+    return None. Must be called collectively (same count on every
+    rank) — the per-call sequence number is the rendezvous key.
+    world_size == 1 short-circuits to a local one-rank view."""
+    from ..distributed.env import peek_rank, peek_world_size
+    from . import telemetry_snapshot
+
+    snap = telemetry_snapshot()
+    rank, world = peek_rank(), peek_world_size()
+    rec = {"rank": rank, "stats": snap["stats"],
+           "hists": snap.get("hists") or {}}
+    seq = next(_snap_seq)
+    if world <= 1:
+        view = merge_records([rec])
+        view["stragglers"] = straggler_report([rec])
+        return view
+    from ..distributed import store_collective as _sc
+
+    store = _sc.get_store(timeout)
+    key = f"__fleet_snap__/{seq}/{rank}"
+    store.put(key, json.dumps(rec), ttl=max(60, int(timeout) * 4))
+    if rank != 0:
+        return None
+    prefix = f"__fleet_snap__/{seq}/"
+    deadline = time.monotonic() + float(timeout)
+    while True:
+        items = store.list(prefix)
+        if len(items) >= world:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"fleet_snapshot: {len(items)}/{world} rank "
+                f"records after {timeout}s — is every rank calling "
+                "fleet_snapshot()?")
+        time.sleep(0.05)
+    records = []
+    for k, v in sorted(items.items()):  # list() returns {key: value}
+        try:
+            records.append(json.loads(v))
+        except ValueError:
+            _cmon.stat_add("monitor/fleet/bad_records", 1)
+        try:  # best-effort cleanup; the ttl reaps leftovers
+            store.delete(k)
+        except Exception:
+            pass
+    view = merge_records(records)
+    view["stragglers"] = straggler_report(records)
+    return view

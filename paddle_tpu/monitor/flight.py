@@ -75,18 +75,12 @@ __all__ = [
 DUMP_SCHEMA = "paddle_tpu.flight/1"
 
 
-def _env_int(name, default):
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+# ONE home for the env-knob parsers (the PR-13 dedup discipline),
+# shared with core.monitor's Histogram config — aliased here because
+# every monitor-side consumer (chaos, trace, fleet, serving) reaches
+# them as flight._env_*
+_env_int = _cmon._env_int
+_env_float = _cmon._env_float
 
 
 _FALSY = ("0", "false", "off", "no")
