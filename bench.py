@@ -26,6 +26,7 @@ recorded "CUDAPlace/V100" proxies; north star >= 1/1.2 of them):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -62,10 +63,21 @@ def _measure(step, args, steps, warmup):
     return float(np.median(dts)), first, last, dts
 
 
-# peak bf16 chip throughput used for the MFU column. v5e ~197 TF/s
-# dense bf16; override for other chips via env.
-PEAK_TFLOPS = float(__import__("os").environ.get(
-    "BENCH_PEAK_TFLOPS", "197"))
+def peak_tflops():
+    """Peak bf16 chip TF/s for the MFU column. BENCH_PEAK_TFLOPS
+    still wins (back-compat with older trail records), otherwise the
+    monitor/perf device-kind table supplies it — the SAME source the
+    per-program MFU in extra.perf uses, so the two columns can never
+    disagree on the peak (ISSUE 16)."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    try:
+        from paddle_tpu.monitor import perf as _perf
+
+        return float(_perf.device_peaks()["peak_tflops"])
+    except Exception:
+        return 197.0  # v5e dense bf16, the historical default
 
 
 def _param_count(model):
@@ -73,17 +85,22 @@ def _param_count(model):
 
 
 def _mfu(flops_per_step, dt):
-    """Model FLOPs utilization against PEAK_TFLOPS. For transformers
+    """Model FLOPs utilization against peak_tflops(). For transformers
     flops = 6*N*tokens (param FLOPs, fwd+bwd); convnets use published
     per-image forward GFLOPs x3."""
-    return round(flops_per_step / dt / (PEAK_TFLOPS * 1e12), 4)
+    return round(flops_per_step / dt / (peak_tflops() * 1e12), 4)
 
 
-def _pack(value, unit, dts, mfu=None):
+def _pack(value, unit, dts, mfu=None, program=None, flops=None):
     r = {"value": value, "unit": unit,
          "window_spread": [round(d, 6) for d in dts]}
     if mfu is not None:
         r["mfu"] = mfu
+    if program is not None:
+        # ties the config row to its perf/program/* ledger entry so
+        # extra.perf can price analytic-vs-compiler FLOPs drift
+        r["program"] = program
+        r["analytic_flops_per_step"] = flops
     return r
 
 
@@ -118,8 +135,9 @@ def bench_mnist(on_tpu):
     dt, first, last, dts = _measure(step, (x, y), steps, warmup)
     _check_decreasing("mnist", first, last)
     # LeNet fwd ~= 0.00042 GF/img (published MACs x2), fwd+bwd ~3x
-    r = _pack(round(batch / dt, 1), "imgs/s", dts,
-              _mfu(3 * 0.00042e9 * batch, dt))
+    fl = 3 * 0.00042e9 * batch
+    r = _pack(round(batch / dt, 1), "imgs/s", dts, _mfu(fl, dt),
+              program=step._perf_name, flops=fl)
     r["note"] = ("dispatch/tunnel latency probe: at this model size "
                  "the number measures the harness round-trip, not the "
                  "framework — do not read vs_baseline as a win "
@@ -244,8 +262,9 @@ def bench_resnet50(on_tpu):
     dt, first, last, dts = _measure(step, (x, y), steps, warmup)
     _check_decreasing("resnet50", first, last)
     # ResNet-50 fwd 4.09 GF/img at 224x224 (published), fwd+bwd ~3x
-    return _pack(round(batch / dt, 1), "imgs/s", dts,
-                 _mfu(3 * 4.09e9 * batch, dt))
+    fl = 3 * 4.09e9 * batch
+    return _pack(round(batch / dt, 1), "imgs/s", dts, _mfu(fl, dt),
+                 program=step._perf_name, flops=fl)
 
 
 class _SynthImageNet:
@@ -401,8 +420,9 @@ def bench_resnet50_pipeline(on_tpu):
     dt = float(np.median(dts))
     # MFU for the pipeline-fed config too (ISSUE 8: MFU per config) —
     # same per-image FLOPs as the synthetic resnet50 config
-    r = _pack(round(batch / dt, 1), "imgs/s", dts,
-              _mfu(3 * 4.09e9 * batch, dt))
+    fl = 3 * 4.09e9 * batch
+    r = _pack(round(batch / dt, 1), "imgs/s", dts, _mfu(fl, dt),
+              program=step._perf_name, flops=fl)
     r["loader_view_imgs_s"] = view_rate
     r["loader_imgs_s"] = loader_rate
     r["host_cpus"] = os.cpu_count()
@@ -466,8 +486,9 @@ def bench_bert(on_tpu):
     tt = paddle.to_tensor(np.zeros((batch, seq), np.int64))
     dt, first, last, dts = _measure(step, (ids, tt, ids), steps, warmup)
     _check_decreasing("bert", first, last)
+    fl = 6 * _param_count(model) * batch * seq
     return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
-                 _mfu(6 * _param_count(model) * batch * seq, dt))
+                 _mfu(fl, dt), program=step._perf_name, flops=fl)
 
 
 def bench_gpt2(on_tpu):
@@ -511,8 +532,9 @@ def bench_gpt2(on_tpu):
                                           (batch, seq)).astype(np.int32))
     dt, first, last, dts = _measure(step, (ids, labels), steps, warmup)
     _check_decreasing("gpt2", first, last)
+    fl = 6 * _param_count(model) * batch * seq
     return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
-                 _mfu(6 * _param_count(model) * batch * seq, dt))
+                 _mfu(fl, dt), program=step._perf_name, flops=fl)
 
 
 def bench_ernie(on_tpu):
@@ -560,8 +582,9 @@ def bench_ernie(on_tpu):
     dt, first, last, dts = _measure(step, (ids, labels), steps, warmup)
     _check_decreasing("ernie", first, last)
     set_mesh(None)
+    fl = 6 * _param_count(model) * batch * seq
     return _pack(round(batch * seq / dt, 1), "tokens/s", dts,
-                 _mfu(6 * _param_count(model) * batch * seq, dt))
+                 _mfu(fl, dt), program=step._perf_name, flops=fl)
 
 
 def _itl_ms(gaps):
@@ -867,9 +890,11 @@ def bench_qcomm(on_tpu):
         set_mesh(prev)
 
 
-def main():
+def main(argv=None):
     import jax
 
+    argv = list(sys.argv[1:] if argv is None else argv)
+    baseline = "--baseline" in argv
     on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
     suite = {
         "mnist_lenet": bench_mnist,
@@ -1046,6 +1071,39 @@ def main():
         results["linalg_counters"] = {
             k: v for k, v in stats.items()
             if k.startswith("linalg/")}
+        # compute attribution (ISSUE 16): the roofline ledger behind
+        # every MFU column — per-program compiler-reported FLOPs/bytes
+        # (perf/program/*), measured dispatch quantiles, achieved
+        # FLOP/s, per-program MFU against the SAME peak table the
+        # config MFU columns use, and the roofline verdict. Plus
+        # analytic-vs-compiler FLOPs drift per config: the published
+        # formulas the MFU columns are built on, sanity-checked
+        # against what XLA says the program actually executes — a
+        # drifting ratio means the MFU trajectory is mispriced
+        from paddle_tpu.monitor import perf as _perf
+
+        perf_rep = _perf.perf_report()
+        drift = {}
+        for cname, rec in results.items():
+            if not isinstance(rec, dict) or "program" not in rec:
+                continue
+            prog = rec["program"]
+            an = rec.get("analytic_flops_per_step")
+            comp = (perf_rep["programs"].get(prog) or {}).get("flops")
+            drift[cname] = {
+                "program": prog,
+                "analytic_flops": an,
+                "compiler_flops": comp,
+                "ratio": (round(an / comp, 4)
+                          if an and comp else None)}
+        results["perf"] = {
+            "enabled": _perf.program_capture_enabled(),
+            "peaks": perf_rep["peaks"],
+            "programs": perf_rep["programs"],
+            "flops_drift": drift,
+            "gauges": {k: v for k, v in stats.items()
+                       if k.startswith(("perf/", "step/attrib/"))},
+        }
     except Exception as e:
         results["telemetry"] = {"error": f"{type(e).__name__}: {e}"}
     # zero-overhead contract, asserted OUTSIDE the telemetry
@@ -1064,6 +1122,16 @@ def main():
         assert not leaked, (
             "disarmed sanitizers left counters behind "
             f"(zero-overhead contract broken): {leaked}")
+    # same contract for the perf plane: PADDLE_PERF_PROGRAM=0 must
+    # leave the perf/program/* ledger empty — a disarmed opt-out that
+    # still pays capture compiles (or writes gauges) is not an opt-out
+    perf_extra = results.get("perf")
+    if isinstance(perf_extra, dict) and not perf_extra["enabled"]:
+        leaked = {k: v for k, v in perf_extra["gauges"].items()
+                  if k.startswith("perf/") and v}
+        assert not leaked, (
+            "PADDLE_PERF_PROGRAM=0 left perf gauges behind "
+            f"(zero-overhead contract broken): {leaked}")
 
     flag = results.get("gpt2_345m", {})
     out = {
@@ -1075,7 +1143,29 @@ def main():
         "extra": results,
     }
     print(json.dumps(out))
+    if baseline:
+        # regression gate (ISSUE 16): compare THIS run against the
+        # newest BENCH_r*.json trail round with window_spread-derived
+        # noise bands; nonzero rc fails the bench invocation
+        import tempfile
+
+        bench_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks")
+        if bench_dir not in sys.path:
+            sys.path.insert(0, bench_dir)
+        import regress
+
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix="bench_baseline_",
+                delete=False) as f:
+            json.dump(out, f)
+            cur_path = f.name
+        try:
+            return regress.main(["--current", cur_path])
+        finally:
+            os.unlink(cur_path)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

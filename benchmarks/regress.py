@@ -1,0 +1,224 @@
+"""Bench-trail regression gate (ISSUE 16): compare the newest
+BENCH_r*.json round against the newest prior round, per config, with
+noise bands derived from each record's own window_spread.
+
+The repo-root BENCH_r*.json files are the bench trail — one record
+per optimization round, each carrying `parsed.extra.<config>.value`
+(throughput, higher is better) and `window_spread` (the per-window
+wall times bench.py measured the median from). Until now nothing
+read them: a plateau or a regression between rounds was invisible to
+any gate. This module closes that loop:
+
+    python benchmarks/regress.py                # newest vs prior
+    python benchmarks/regress.py --current f.json   # f vs newest
+    python bench.py --baseline                  # live run vs trail
+
+Noise bands, not fixed tolerances: a config's band is the relative
+spread of its measurement windows — (max-min)/median of
+window_spread, the same five windows the median throughput came from
+— taken as the max of the two rounds being compared and clamped to
+[BAND_FLOOR, BAND_CAP]. A config that measures noisily (the
+mnist_lenet dispatch-latency probe, the single-core pipeline config)
+gets a wide band from its own data instead of a hand-maintained
+volatile list; a tight config (resnet50) is gated at the floor.
+
+Exit codes (the op_bench gate convention): 0 clean, 2 on any
+regression beyond band / config missing from the current round / bad
+input. Stdlib only — the gate must run anywhere the JSON files do.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIL_GLOB = "BENCH_r*.json"
+# relative noise-band clamp: never gate tighter than 5% (timer
+# jitter on a quiet config), never looser than 50% (a halved
+# throughput fails no matter how noisy the config measures)
+BAND_FLOOR = 0.05
+BAND_CAP = 0.5
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def rel_spread(entry):
+    """Relative window spread of one config entry: (max-min)/median
+    of its window_spread wall times. None when the record carries
+    fewer than two windows (no spread to derive a band from)."""
+    ws = [float(w) for w in (entry.get("window_spread") or [])
+          if w and float(w) > 0]
+    if len(ws) < 2:
+        return None
+    ws.sort()
+    med = ws[len(ws) // 2]
+    return (ws[-1] - ws[0]) / med if med > 0 else None
+
+
+def noise_band(base_entry, cur_entry, floor=BAND_FLOOR, cap=BAND_CAP):
+    """The comparison band for one config: the WIDER of the two
+    rounds' relative spreads (either side measuring noisily makes
+    the delta unreadable), clamped to [floor, cap]."""
+    spreads = [s for s in (rel_spread(base_entry),
+                           rel_spread(cur_entry)) if s is not None]
+    band = max(spreads) if spreads else floor
+    return min(cap, max(floor, band))
+
+
+def load_trail(root=None):
+    """The round records on disk, sorted by round number, keeping
+    only rounds that carry a per-config `parsed.extra` dict (early
+    rounds predate it). Raises ValueError on unreadable JSON — the
+    exit-2 contract."""
+    root = root or REPO_ROOT
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, TRAIL_GLOB))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not valid JSON ({e})")
+        m = _ROUND_RE.search(path)
+        n = int(rec.get("n", m.group(1) if m else 0))
+        extra = (rec.get("parsed") or {}).get("extra")
+        if isinstance(extra, dict) and extra:
+            out.append({"n": n, "path": path, "extra": extra})
+    out.sort(key=lambda r: r["n"])
+    return out
+
+
+def _configs(extra):
+    """The gateable config entries of one round: dict-valued extra
+    entries with a numeric throughput value (the extra dict also
+    carries non-config sections like `perf` and `telemetry`)."""
+    out = {}
+    for k, v in extra.items():
+        if isinstance(v, dict) and isinstance(
+                v.get("value"), (int, float)):
+            out[k] = v
+    return out
+
+
+def compare(base_extra, cur_extra, floor=BAND_FLOOR, cap=BAND_CAP):
+    """Per-config verdicts comparing `cur` against `base` (both
+    `parsed.extra` dicts). Statuses: ok / regression (value fell
+    below base*(1-band)) / missing (config vanished — the silent
+    failure mode a gate exists for) / new (no baseline yet)."""
+    base_cfg = _configs(base_extra)
+    cur_cfg = _configs(cur_extra)
+    rows = []
+    for name in sorted(set(base_cfg) | set(cur_cfg)):
+        b, c = base_cfg.get(name), cur_cfg.get(name)
+        if b is None:
+            rows.append({"config": name, "status": "new",
+                         "current": c["value"]})
+            continue
+        if c is None:
+            rows.append({"config": name, "status": "missing",
+                         "baseline": b["value"]})
+            continue
+        band = noise_band(b, c, floor=floor, cap=cap)
+        ratio = (c["value"] / b["value"]) if b["value"] else 1.0
+        status = "regression" if ratio < 1.0 - band else "ok"
+        rows.append({"config": name, "status": status,
+                     "baseline": b["value"], "current": c["value"],
+                     "ratio": round(ratio, 4),
+                     "band": round(band, 4),
+                     "unit": c.get("unit") or b.get("unit")})
+    return rows
+
+
+def gate(rows):
+    """rc for a comparison: 2 when any row regressed or vanished."""
+    return 2 if any(r["status"] in ("regression", "missing")
+                    for r in rows) else 0
+
+
+def _render(rows, base_label, cur_label):
+    out = [f"bench regression gate: {cur_label} vs {base_label}"]
+    for r in rows:
+        s = r["status"]
+        if s == "new":
+            out.append(f"  NEW        {r['config']}: "
+                       f"{r['current']} (no baseline round)")
+        elif s == "missing":
+            out.append(f"  MISSING    {r['config']}: was "
+                       f"{r['baseline']} — absent from current round")
+        else:
+            tag = "REGRESSION" if s == "regression" else "OK"
+            out.append(
+                f"  {tag:<10s} {r['config']}: {r['baseline']} -> "
+                f"{r['current']} {r.get('unit') or ''} "
+                f"(x{r['ratio']}, band {r['band']})")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="benchmarks/regress.py",
+        description="Gate the newest bench round against the prior "
+                    "one with window_spread-derived noise bands.")
+    p.add_argument("--root", default=None,
+                   help="directory holding the BENCH_r*.json trail "
+                        "(default: the repo root)")
+    p.add_argument("--current", default=None,
+                   help="compare THIS record (a bench.py JSON "
+                        "output) against the newest trail round, "
+                        "instead of newest-vs-prior")
+    p.add_argument("--floor", type=float, default=BAND_FLOOR,
+                   help=f"noise-band floor (default {BAND_FLOOR})")
+    p.add_argument("--cap", type=float, default=BAND_CAP,
+                   help=f"noise-band cap (default {BAND_CAP})")
+    p.add_argument("--json", action="store_true",
+                   help="emit the per-config verdict rows as JSON")
+    args = p.parse_args(argv)
+    try:
+        trail = load_trail(args.root)
+        if args.current:
+            if not trail:
+                raise ValueError(
+                    "no BENCH_r*.json rounds with parsed.extra to "
+                    "compare against")
+            with open(args.current) as f:
+                cur_rec = json.load(f)
+            cur_extra = (cur_rec.get("parsed") or {}).get("extra") \
+                or cur_rec.get("extra")
+            if not isinstance(cur_extra, dict):
+                raise ValueError(
+                    f"{args.current}: no parsed.extra/extra section")
+            base = trail[-1]
+            base_label, cur_label = (f"r{base['n']:02d}",
+                                     args.current)
+        else:
+            if len(trail) < 2:
+                raise ValueError(
+                    "need at least two BENCH_r*.json rounds with "
+                    "parsed.extra (newest is compared to prior)")
+            base, cur = trail[-2], trail[-1]
+            cur_extra = cur["extra"]
+            base_label, cur_label = (f"r{base['n']:02d}",
+                                     f"r{cur['n']:02d}")
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    rows = compare(base["extra"], cur_extra,
+                   floor=args.floor, cap=args.cap)
+    if args.json:
+        json.dump({"base": base_label, "current": cur_label,
+                   "rows": rows}, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(_render(rows, base_label, cur_label))
+    rc = gate(rows)
+    if rc:
+        print("regression beyond noise band — see rows above",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,7 @@ import numpy as np
 from ...core import monitor as _cmon
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import perf as _perf
 from ...monitor import trace as _trace
 from . import model_runner as _mr
 from .kv_cache import NULL_BLOCK, PagedKVCache, env_max_batch
@@ -142,6 +143,13 @@ class LLMEngine:
         self._prefill_jits = {}      # padded len -> jitted prefill
         self._pcache_label = (
             f"serve_decode:{type(model).__name__}")
+        self._prefill_label = (
+            f"serve_prefill:{type(model).__name__}")
+        # padded len -> ledger ordinal: each prefill bucket is its
+        # own compiled program and gets its own perf/program entry
+        # (first bucket keeps the plain label, later ones "#n" —
+        # the jit shape-specialization naming)
+        self._prefill_captured = {}
         self._oom_streak = 0         # consecutive OOM'd dispatches
         # finished requests kept for result retrieval — bounded so a
         # long-lived replica's host memory doesn't grow with total
@@ -316,6 +324,10 @@ class LLMEngine:
         table = self.cache.block_table(req.req_id,
                                        self.max_blocks_per_seq)
         s = req.sampling
+        # a fresh bucket's first dispatch runs the lazy XLA compile —
+        # keep that sample out of the dispatch histogram (it would
+        # poison the p99), but still count it in serve/prefill_us
+        fresh_bucket = padded not in self._prefill_jits
         t0 = time.perf_counter()
         with _flight.in_flight("serve_prefill", req.req_id,
                                tokens=plen):
@@ -327,6 +339,13 @@ class LLMEngine:
             tok = int(tok)
         dur_us = int((time.perf_counter() - t0) * 1e6)
         _cmon.stat_add("serve/prefill_us", dur_us)
+        if not fresh_bucket and _perf.dispatch_timing_enabled():
+            # `int(tok)` above already blocked on the dispatch —
+            # this wall time is device time, not the enqueue
+            _perf.observe_dispatch(self._prefill_label, dur_us)
+        if padded not in self._prefill_captured:
+            self._prefill_captured[padded] = len(self._prefill_captured)
+            self._capture_prefill_cost(padded, ids, plen, table, s)
         if _trace._armed:
             # replayed > 0 marks an eviction-recompute or a failover/
             # drain replay leg (the preserved output_ids re-prefill)
@@ -334,6 +353,31 @@ class LLMEngine:
                         replayed=len(req.output_ids))
         self.heartbeat = time.monotonic()
         return tok
+
+    def _capture_prefill_cost(self, padded, ids, plen, table, s):
+        """Roofline-ledger capture for one prefill bucket: an AOT
+        lower+compile over the just-dispatched shapes (the NEW pools
+        stand in for the donated-away ones — same avals), then
+        `perf/program/serve_prefill:<Model>[#n]/*`. One extra backend
+        compile per bucket, first dispatch only — the jit capture
+        discipline; PADDLE_PERF_PROGRAM=0 opts out. Never raises."""
+        import jax.numpy as jnp
+
+        if not _perf.program_capture_enabled():
+            return
+        try:
+            n = self._prefill_captured[padded]
+            name = (self._prefill_label if n == 0
+                    else f"{self._prefill_label}#{n}")
+            with _flight.in_flight("perf_capture", name):
+                compiled = self._prefill_fn(padded).lower(
+                    self.params, jnp.asarray(ids), np.int32(plen),
+                    self.cache.k, self.cache.v, jnp.asarray(table),
+                    np.float32(s.temperature), np.int32(s.top_k),
+                    np.uint32(0)).compile()
+            _perf.record_program_cost(name, compiled)
+        except Exception:
+            pass  # the ledger is observability, never a serving error
 
     # -- decode ------------------------------------------------------
     def _batch_arrays(self):
@@ -392,6 +436,7 @@ class LLMEngine:
 
         self._decode_exe = self._decode_jit
         if not _pcache.enabled():
+            self._capture_decode_cost(args)
             return
         try:
             lowered = self._decode_jit.lower(*args)
@@ -399,8 +444,31 @@ class LLMEngine:
                 lowered, self._pcache_label)
             if outcome != "off":
                 self._decode_exe = compiled
+                # pcache just handed us the compiled executable —
+                # the ledger capture is free here
+                self._capture_decode_cost(args, compiled=compiled)
+            else:
+                self._capture_decode_cost(args)
         except Exception:
             self._decode_exe = self._decode_jit
+            self._capture_decode_cost(args)
+
+    def _capture_decode_cost(self, args, compiled=None):
+        """Roofline-ledger capture for the decode program
+        (`perf/program/serve_decode:<Model>/*`). Reuses the
+        persistent-cache executable when one exists; otherwise one
+        extra AOT backend compile at first dispatch —
+        PADDLE_PERF_PROGRAM=0 opts out. Never raises."""
+        if not _perf.program_capture_enabled():
+            return
+        try:
+            if compiled is None:
+                with _flight.in_flight("perf_capture",
+                                       self._pcache_label):
+                    compiled = self._decode_jit.lower(*args).compile()
+            _perf.record_program_cost(self._pcache_label, compiled)
+        except Exception:
+            pass  # the ledger is observability, never a serving error
 
     def _pools_deleted(self):
         """Did a failed DONATING dispatch consume the pools? (A real
@@ -430,6 +498,9 @@ class LLMEngine:
         if not self.scheduler.running:
             return
         arrays = self._batch_arrays()
+        # first decode dispatch compiles (and runs _load_persistent)
+        # — keep it out of the dispatch histogram like prefill
+        fresh_decode = self._decode_exe is None
         t0 = time.perf_counter()
         try:
             with _flight.in_flight("serve_decode", "decode",
@@ -462,8 +533,12 @@ class LLMEngine:
             return self._decode_batch(emitted)
         self._oom_streak = 0
         self.heartbeat = time.monotonic()
-        _cmon.stat_add("serve/decode_us",
-                       int((time.perf_counter() - t0) * 1e6))
+        decode_us = int((time.perf_counter() - t0) * 1e6)
+        _cmon.stat_add("serve/decode_us", decode_us)
+        if not fresh_decode and _perf.dispatch_timing_enabled():
+            # _dispatch_decode's np.asarray(toks) already blocked —
+            # measured device time for the roofline, like prefill
+            _perf.observe_dispatch(self._pcache_label, decode_us)
         for slot, req in list(self.scheduler.running.items()):
             self._emit(req, int(toks[slot]), emitted)
 

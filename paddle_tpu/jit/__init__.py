@@ -30,6 +30,7 @@ from ..core import monitor as _monitor
 from ..core.tensor import Tensor
 from ..monitor import chaos as _chaos
 from ..monitor import flight as _flight
+from ..monitor import perf as _perf
 from ..monitor import sanitize as _sanitize
 from ..ops import random as _random
 from . import persistent_cache as _pcache
@@ -187,7 +188,13 @@ def cache_report():
                             # was off/failed) — the HBM-footprint leg
                             # of an OOM post-mortem
                             "memory": [obj._mem.get(k) for k in
-                                       keys[:_CACHE_REPORT_MAX_KEYS]]})
+                                       keys[:_CACHE_REPORT_MAX_KEYS]],
+                            # per-entry cost_analysis() dicts, same
+                            # alignment — the roofline ledger's
+                            # bundle-portable copy (monitor perf
+                            # reads these offline)
+                            "cost": [obj._cost.get(k) for k in
+                                     keys[:_CACHE_REPORT_MAX_KEYS]]})
             elif isinstance(obj, TrainStepCompiler):
                 out.append({"kind": "train_step",
                             "fn": type(obj._model).__name__,
@@ -195,7 +202,8 @@ def cache_report():
                             "steps": obj._step,
                             "steps_per_dispatch":
                                 getattr(obj, "_steps_per_dispatch", 1),
-                            "memory": obj._mem_analysis})
+                            "memory": obj._mem_analysis,
+                            "cost": obj._cost_analysis})
         except Exception:
             pass  # a half-torn-down object must not break a dump
     out.sort(key=lambda d: (d["kind"], d["fn"]))
@@ -272,6 +280,7 @@ class StaticFunction:
         self._input_spec = input_spec
         self._compiled = {}
         self._mem = {}  # cache key -> memory_analysis() byte dict
+        self._cost = {}  # cache key -> cost_analysis() flop/byte dict
         # computed once — __call__ is the per-train-step hot path
         self._telemetry_key = _telemetry_name(func)
         _live_compiled.add(self)
@@ -288,6 +297,7 @@ class StaticFunction:
         bound._input_spec = self._input_spec
         bound._compiled = self._compiled
         bound._mem = self._mem  # shared like _compiled: ONE cache
+        bound._cost = self._cost
         bound._needs_tape = self._needs_tape
         bound._telemetry_key = self._telemetry_key
         functools.update_wrapper(bound, bound._func,
@@ -388,6 +398,12 @@ class StaticFunction:
                 and not engine.in_trace_mode() \
                 and (any(not p.stop_gradient for p in params)
                      or any(not t.stop_gradient for t in arg_ts))
+            # dispatch wall-time attribution (ISSUE 16): skip the
+            # FIRST call — it runs jfn's lazy XLA compile, and a
+            # compile-laced sample would dominate the p99 of a
+            # program dispatched a handful of times
+            timing = compile_ev is None \
+                and _perf.dispatch_timing_enabled()
             if requires:
                 # differentiable boundary: the compiled forward is one
                 # tape op, so loss.backward() after a @to_static
@@ -398,8 +414,16 @@ class StaticFunction:
                     out_vals, new_bufs, _ = jfn(pv, av, rc)
                     return tuple(out_vals), tuple(new_bufs)
 
+                t_d0 = _time.perf_counter() if timing else None
                 outs, buf_outs = engine.apply_op(
                     "run_program", kernel, list(params), arg_ts, rngc)
+                if timing:
+                    # block on the forward's outputs so the sample is
+                    # device time, not the async enqueue
+                    jax.block_until_ready([o._value for o in outs])
+                    _perf.observe_dispatch(
+                        fname,
+                        int((_time.perf_counter() - t_d0) * 1e6))
                 _random._rng.counter += 1
                 for (buf, _), nv in zip(box["buf_refs"], buf_outs):
                     buf._value = nv._value
@@ -408,7 +432,17 @@ class StaticFunction:
                                                 list(outs))
             pvals = [p._value for p in params]
             avals = [t._value for t in arg_ts]
-            out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
+            if timing:
+                # measured attribution leg of the roofline: wall time
+                # blocked on the outputs (async dispatch returns
+                # futures — an unblocked timer measures the enqueue)
+                t_d0 = _time.perf_counter()
+                out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
+                jax.block_until_ready(out_vals)
+                _perf.observe_dispatch(
+                    fname, int((_time.perf_counter() - t_d0) * 1e6))
+            else:
+                out_vals, new_buf_vals, _ = jfn(pvals, avals, rngc)
             _random._rng.counter += 1
             # commit buffer updates (BatchNorm stats)
             for (buf, _), nv in zip(box["buf_refs"], new_buf_vals):
@@ -514,13 +548,19 @@ class StaticFunction:
     def _capture_memory(self, key, jfn, params, flat_args, tensor_pos):
         """Record the fresh cache entry's memory_analysis() byte
         breakdown (argument/output/temp/generated-code) under
-        mem/program/<fn>/* and in self._mem for cache_report().
+        mem/program/<fn>/* and in self._mem for cache_report(), plus
+        its cost_analysis() flop/byte ledger under perf/program/<fn>/*
+        and self._cost — both read off ONE shared compiled object.
         Lowers via ShapeDtypeStructs — no array materialization; the
         lowering is shared with the call path, the XLA backend pass
-        is one extra compile, so PADDLE_MEM_PROGRAM=0 opts out."""
+        is one extra compile, so PADDLE_MEM_PROGRAM=0 +
+        PADDLE_PERF_PROGRAM=0 together opt out of the compile (either
+        alone only skips its own gauges)."""
         from ..monitor import memory as _memory
 
-        if not _memory.program_capture_enabled():
+        want_mem = _memory.program_capture_enabled()
+        want_cost = _perf.program_capture_enabled()
+        if not (want_mem or want_cost):
             return
         try:
             p_structs = [jax.ShapeDtypeStruct(p._value.shape,
@@ -557,11 +597,18 @@ class StaticFunction:
                 ordinal = len(self._mem)
             name = (self._telemetry_key if ordinal == 0
                     else f"{self._telemetry_key}#{ordinal}")
-            self._mem[key] = _memory.record_program_memory(
-                name, compiled)
+            if want_mem:
+                self._mem[key] = _memory.record_program_memory(
+                    name, compiled)
+            if want_cost:
+                self._cost[key] = _perf.record_program_cost(
+                    name, compiled)
         except Exception:
             # footprint capture is observability, never a build error
-            self._mem[key] = None
+            if want_mem:
+                self._mem[key] = None
+            if want_cost:
+                self._cost[key] = None
 
     def concrete_program(self):
         return None
@@ -895,6 +942,14 @@ class TrainStepCompiler:
         self._opt_state = None
         self._step = 0
         self._mem_analysis = None  # memory_analysis() byte dict
+        self._cost_analysis = None  # cost_analysis() flop/byte dict
+        # telemetry label shared by the cost ledger, the dispatch
+        # histogram and the persistent cache: model class + fused
+        # dispatch width (K=1 siblings must not alias the fused
+        # program's gauges — see _capture_memory)
+        self._perf_name = f"train_step:{type(model).__name__}"
+        if self._steps_per_dispatch != 1:
+            self._perf_name += f"@k{self._steps_per_dispatch}"
         self._restored_opt = None    # elastic-checkpoint preload
         self._restored_accum = None  # (applied at first build)
         self._restored_comm = None
@@ -993,7 +1048,8 @@ class TrainStepCompiler:
                 if _pcache.enabled():
                     self._load_persistent(trainable, frozen, bufs,
                                           batch)
-                out = self._run_compiled(trainable, frozen, bufs, batch)
+                out = self._run_compiled(trainable, frozen, bufs,
+                                         batch, fresh=True)
             compile_us = int((_time.perf_counter() - t0) * 1e6)
             _monitor.stat_add("jit/train_step/compile_us",
                               compile_us)
@@ -1047,13 +1103,19 @@ class TrainStepCompiler:
         (argument/output/temp/generated-code bytes) in
         self._mem_analysis (cache_report()'s "memory" field) and the
         mem/program/train_step:<Model>/* gauges — the per-program HBM
-        footprint an OOM bundle names. Reuses lower_compiled(), so
-        the lowering is shared with the call path and the cost is
-        one extra XLA backend compile; PADDLE_MEM_PROGRAM=0 opts
-        out. Never raises: footprints are observability."""
+        footprint an OOM bundle names — plus its cost_analysis()
+        flop/byte ledger (self._cost_analysis, the
+        perf/program/train_step:<Model>/* gauges) off the SAME
+        compiled object. Reuses lower_compiled(), so the lowering is
+        shared with the call path and the cost is one extra XLA
+        backend compile; PADDLE_MEM_PROGRAM=0 + PADDLE_PERF_PROGRAM=0
+        together opt out of the compile. Never raises: footprints are
+        observability."""
         from ..monitor import memory as _memory
 
-        if not _memory.program_capture_enabled():
+        want_mem = _memory.program_capture_enabled()
+        want_cost = _perf.program_capture_enabled()
+        if not (want_mem or want_cost):
             return
         try:
             # the gauge name carries the model class (compilers over
@@ -1069,10 +1131,7 @@ class TrainStepCompiler:
             # across a sweep's recompiles, and the bundle path
             # (program_footprints) keeps every live footprint via
             # its "(n)" suffixing, so dumps never lose one
-            k = getattr(self, "_steps_per_dispatch", 1)
-            name = f"train_step:{type(self._model).__name__}"
-            if k != 1:
-                name += f"@k{k}"
+            name = self._perf_name
             # span the capture's extra backend compile — it runs after
             # the "compile" span closed, and a multi-minute capture
             # must show in the watchdog's in-flight table, not as an
@@ -1083,12 +1142,33 @@ class TrainStepCompiler:
             _monitor.stat_add(
                 "jit/train_step/mem_capture_us",
                 int((_time.perf_counter() - t0) * 1e6))
-            self._mem_analysis = _memory.record_program_memory(
-                name, compiled)
+            if want_mem:
+                self._mem_analysis = _memory.record_program_memory(
+                    name, compiled)
+            if want_cost:
+                self._cost_analysis = _perf.record_program_cost(
+                    name, compiled)
         except Exception:
-            self._mem_analysis = None
+            if want_mem:
+                self._mem_analysis = None
+            if want_cost:
+                self._cost_analysis = None
 
-    def _run_compiled(self, trainable, frozen, bufs, batch):
+    def _jit_cache_size(self):
+        """Trace-cache entry count of the jitted step (via the jitted
+        original when a _PersistedProgram fronts it) — a dispatch
+        that grows it recompiled inline, so its wall time is not a
+        dispatch sample. None when jax stops exposing the probe
+        (observations then include rare retraces rather than vanish
+        entirely)."""
+        jfn = getattr(self._compiled, "_jfn", self._compiled)
+        try:
+            return jfn._cache_size()
+        except Exception:
+            return None
+
+    def _run_compiled(self, trainable, frozen, bufs, batch,
+                      fresh=False):
         # chaos site "dispatch": a synthetic RESOURCE_EXHAUSTED here
         # exercises the real OOM-forensics path (is_oom_error
         # classifies by exception NAME + message)
@@ -1117,6 +1197,13 @@ class TrainStepCompiler:
         rngc = np.uint32(self._step)
         prev_opt, prev_acc = self._opt_state, self._accum_state
         prev_comm = self._comm_state
+        # skip the fresh (first) dispatch — it runs the lazy XLA
+        # compile, and a compile-laced sample would poison the p99
+        t_d0 = (_time.perf_counter()
+                if not fresh and _perf.dispatch_timing_enabled()
+                else None)
+        n_traces0 = self._jit_cache_size() if t_d0 is not None \
+            else None
         try:
             new_p, new_opt, new_acc, new_comm, new_b, loss, skips = \
                 self._compiled(
@@ -1137,6 +1224,22 @@ class TrainStepCompiler:
             # reference reports PTA041 with both ends named
             _sanitize.note_donated((pvals, prev_opt, prev_acc,
                                     prev_comm), site=san_site)
+        if t_d0 is not None \
+                and self._jit_cache_size() == n_traces0:
+            # measured roofline leg: block on the loss (the whole
+            # program has executed once any output is ready) so the
+            # histogram sees device time, not the async enqueue. One
+            # ring event per dispatch feeds the StepTimer step-time
+            # decomposition and the fleet straggler's top-span table.
+            # A dispatch that grew the jit cache retraced (e.g. the
+            # second call, where the freshly initialized opt state's
+            # weak types strengthen) — compile-laced, skip it like
+            # the fresh dispatch
+            jax.block_until_ready(loss)
+            dus = int((_time.perf_counter() - t_d0) * 1e6)
+            _perf.observe_dispatch(self._perf_name, dus)
+            _flight.record("dispatch_end", name=self._perf_name,
+                           dur_us=dus)
         self._opt_state = new_opt
         self._accum_state = new_acc
         self._comm_state = new_comm

@@ -38,6 +38,7 @@ from ...core.tensor import Tensor
 from ...distributed import mesh as _mesh_mod
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
+from ...monitor import perf as _perf
 from ...monitor import sanitize as _sanitize
 
 __all__ = ["Grid", "grid", "lint_spec", "compile_program", "dispatch",
@@ -237,6 +238,10 @@ def compile_program(label, build, grid_, args, extra_key=()):
     _monitor.stat_add("linalg/compiles", 1)
     _monitor.stat_add("linalg/compile_us",
                       int((_time.perf_counter() - t0) * 1e6))
+    # roofline ledger: the compiled executable is already in hand on
+    # this fresh-compile path, so the cost capture is free (no extra
+    # backend compile, unlike the jit/serving capture sites)
+    _perf.record_program_cost(f"linalg:{label}", compiled)
     _programs[key] = compiled
     while len(_programs) > _PROGRAMS_MAX:
         _programs.popitem(last=False)
@@ -268,8 +273,17 @@ def dispatch(kind, label, compiled, args):
         _chaos.hit("linalg_dispatch", op=label)
     tok = _flight.begin("linalg", label, bytes=nbytes) \
         if _flight.recorder.enabled else None
+    timing = _perf.dispatch_timing_enabled()
+    t0 = _time.perf_counter() if timing else None
     try:
         out = compiled(*args)
+        if timing:
+            # block before the span closes so the flight `linalg`
+            # span and the dispatch histogram both see device time
+            jax.block_until_ready(out)
+            _perf.observe_dispatch(
+                f"linalg:{label}",
+                int((_time.perf_counter() - t0) * 1e6))
     finally:
         _flight.end(tok)
     _monitor.stat_add(f"linalg/{kind}", 1)

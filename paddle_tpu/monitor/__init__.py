@@ -62,6 +62,7 @@ from ..core.monitor import (  # noqa: F401 — the counter surface
 )
 from . import flight  # noqa: E402 — the failure-forensics leg
 from . import memory  # noqa: E402 — the device-memory leg
+from . import perf  # noqa: E402 — the compute/roofline leg (ISSUE 16)
 from . import chaos  # noqa: E402 — deterministic fault injection
 from . import sanitize  # noqa: E402 — runtime sanitizer core (ISSUE 10)
 from . import trace  # noqa: E402 — per-request serving traces (ISSUE 15)
@@ -74,7 +75,7 @@ __all__ = [
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
     "get_exporter", "telemetry_snapshot", "fleet_snapshot", "flight",
-    "memory", "chaos", "trace", "fleet",
+    "memory", "perf", "chaos", "trace", "fleet",
 ]
 
 
@@ -132,8 +133,21 @@ class StepTimer:
     profiler.Profiler is capturing — records counter samples that
     export as chrome-trace ph "C" events."""
 
+    # flight-ring event kind -> step-attribution wall (ISSUE 16): the
+    # spans/events the instrumented layers ALREADY leave per step,
+    # bucketed into where the wall time went. Whatever the ring
+    # doesn't explain is host time (Python, optimizer host math,
+    # tracing) — the remainder bucket
+    _ATTRIB_KINDS = {
+        "dispatch_end": "device", "serve_decode_end": "device",
+        "serve_prefill_end": "device", "linalg_end": "device",
+        "collective_end": "comm",
+        "io_fetch": "io", "io_h2d": "io", "ckpt_write_end": "io",
+    }
+
     def __init__(self, window=100):
         self._t0 = None
+        self._wall0 = None   # wall-clock twin of _t0 (ring ts domain)
         self._window = int(window)
         self._times = []     # recent step durations (seconds)
         self._last = {}
@@ -141,6 +155,7 @@ class StepTimer:
 
     def begin_step(self):
         self._t0 = time.perf_counter()
+        self._wall0 = time.time()
         flight.record("step_begin")
 
     def end_step(self, batch_size=None, loss=None, lr=None):
@@ -195,6 +210,19 @@ class StepTimer:
             stat_set("step/device_mem_bytes_in_use", used)
             registry.get("step/device_mem_peak_bytes").maximum(peak)
 
+        # step-time decomposition (ISSUE 16, PADDLE_PERF_STEP=0
+        # disables): bucket the flight ring's spans that closed
+        # inside this step into device/comm/io walls; the
+        # unexplained remainder is host time. Clamped — overlapped
+        # walls (a feeder thread's h2d under a device dispatch) can
+        # sum past the step, and a decomposition that exceeds 100%
+        # reads as nonsense
+        if perf.step_attrib_enabled() and self._wall0 is not None:
+            attrib = self._step_attrib(int(dt * 1e6))
+            if attrib is not None:
+                for wall, us in attrib.items():
+                    stat_set(f"step/attrib/{wall}_us", us)
+
         from .. import profiler as _prof
 
         if _prof.is_recording():
@@ -217,6 +245,38 @@ class StepTimer:
                       batch_size=batch_size,
                       loss=None if loss is None else float(loss))
         return dt
+
+    def _step_attrib(self, dt_us):
+        """{device, comm, io, host} µs for the step that just ended,
+        from the ring events stamped since begin_step. Best effort:
+        a cleared/disabled ring yields None (no gauges written — a
+        zeroed decomposition would read as an all-host step)."""
+        buckets = {"device": 0, "comm": 0, "io": 0}
+        saw = False
+        try:
+            for ev in flight.recorder.tail(512):
+                if ev.get("ts", 0.0) < self._wall0:
+                    continue
+                saw = True
+                wall = self._ATTRIB_KINDS.get(ev.get("kind"))
+                if wall is None:
+                    continue
+                buckets[wall] += int(ev.get("dur_us")
+                                     or ev.get("us") or 0)
+        except Exception:
+            return None
+        if not saw:
+            # not even our own step_begin event → ring off/cleared
+            return None
+        known = sum(buckets.values())
+        if dt_us > 0 and known > dt_us:
+            scale = dt_us / known
+            for wall in buckets:
+                buckets[wall] = int(buckets[wall] * scale)
+            buckets["host"] = 0
+        else:
+            buckets["host"] = max(0, dt_us - known)
+        return buckets
 
     def summary(self):
         n = len(self._times)

@@ -43,8 +43,19 @@ artifacts the runtime leaves behind:
       flight dump bundles, raw telemetry snapshots) into one fleet
       view — counters summed, gauges per-rank, histograms
       bucket-merged with fleet p50/p99 — and flag stragglers
-      (per-rank mean step time vs the fleet median, the slowest
-      rank attributed with its longest flight spans).
+      (per-rank mean step time vs the fleet median, flagged ranks
+      attributed with their longest flight spans and — when the
+      spool carries per-program dispatch histograms — their
+      slowest program).
+
+  perf [bundle.json] [--json]
+      Roofline attribution (ISSUE 16): the perf/program/* cost
+      ledger joined with measured dispatch histograms into
+      per-program achieved FLOP/s, arithmetic intensity and MFU
+      against the device-kind peak table, with a compute/HBM/comm
+      -bound verdict per program. Reads THIS process's live
+      registries by default, or a flight dump bundle / telemetry
+      snapshot JSON for offline forensics.
 """
 from __future__ import annotations
 
@@ -248,6 +259,88 @@ def cmd_memory(args):
         sys.stdout.write("\n")
         return 0
     print("\n".join(_memory_lines(report)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# perf (roofline attribution: live registries or a dump bundle)
+# ---------------------------------------------------------------------------
+
+def _fmt_flops(n):
+    """Human FLOP count (the byte formatter's decimal sibling)."""
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000.0 or unit == "P":
+            return (f"{n:.0f}{unit}" if unit == ""
+                    else f"{n:.2f}{unit}")
+        n /= 1000.0
+
+
+def _perf_lines(rep):
+    """Render a perf_report() dict as indented text lines."""
+    out = []
+    pk = rep.get("peaks") or {}
+    out.append(f"perf: device {pk.get('device_kind', '?')} "
+               f"(peak table: {pk.get('matched', '?')}) — "
+               f"{pk.get('peak_tflops')} TFLOP/s, "
+               f"HBM {pk.get('hbm_gbps')} GB/s, "
+               f"ICI {pk.get('ici_gbps')} GB/s")
+    comm = rep.get("comm") or {}
+    out.append(f"  comm leg: {_fmt_bytes(comm.get('wire_bytes'))} "
+               f"on the wire (~{comm.get('est_us')}us at ICI "
+               f"bandwidth, {100 * (comm.get('frac') or 0.0):.1f}% "
+               f"of {rep.get('measured_total_us')}us measured "
+               "dispatch time)")
+    progs = rep.get("programs") or {}
+    if not progs:
+        out.append("  no perf/program/* ledger entries — "
+                   "PADDLE_PERF_PROGRAM=0, or nothing compiled yet")
+        return out
+    out.append("  roofline ledger (by flops):")
+    for name in sorted(progs,
+                       key=lambda n: -(progs[n].get("flops") or 0)):
+        e = progs[name]
+        line = (f"    {name}: {_fmt_flops(e.get('flops'))}F, "
+                f"{_fmt_bytes(e.get('bytes_accessed'))} accessed")
+        if e.get("intensity") is not None:
+            line += f", AI {e['intensity']}"
+        d = e.get("dispatch")
+        if d:
+            line += (f", n={d['count']} p50={d['p50_us']}us "
+                     f"p99={d['p99_us']}us")
+        if e.get("achieved_gflops") is not None:
+            line += f", {e['achieved_gflops']} GFLOP/s"
+        if e.get("mfu") is not None:
+            line += f", MFU {100 * e['mfu']:.2f}%"
+        out.append(line + f"  -> {e.get('verdict')}")
+    return out
+
+
+def cmd_perf(args):
+    from . import perf as perf_mod
+
+    if args.bundle:
+        with open(args.bundle) as f:
+            bundle = json.load(f)
+        # a flight dump bundle nests telemetry; a raw
+        # telemetry_snapshot() / exporter record IS the telemetry
+        tel = bundle.get("telemetry") or bundle
+        stats = tel.get("stats")
+        if not isinstance(stats, dict):
+            raise ValueError(
+                f"{args.bundle}: no telemetry stats found (expected "
+                "a flight dump bundle or a telemetry snapshot)")
+        report = perf_mod.perf_report(stats=stats,
+                                      hists=tel.get("hists") or {})
+    else:
+        report = perf_mod.perf_report()
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+        return 0
+    print("\n".join(_perf_lines(report)))
     return 0
 
 
@@ -592,6 +685,13 @@ def cmd_fleet(args):
                         f"    {sp['kind']}"
                         + (f"/{sp['name']}" if sp.get("name") else "")
                         + f"  {sp['dur_us']}us")
+                prog = s.get("slowest_program")
+                if prog:
+                    out.append(
+                        f"    slowest program: {prog['program']}  "
+                        f"{prog['total_us']}us total over "
+                        f"{prog['count']} dispatch(es), "
+                        f"p50 {prog['p50_us']}us")
         else:
             out.append("  no stragglers flagged")
     else:
@@ -701,6 +801,18 @@ def main(argv=None):
                     help="show every merged counter, not just the "
                          "step/serve/comm/io/jit families")
     pf.set_defaults(fn=cmd_fleet)
+
+    pp = sub.add_parser(
+        "perf",
+        help="roofline attribution: per-program cost ledger + "
+             "measured dispatch time vs the device peak table")
+    pp.add_argument("bundle", nargs="?",
+                    help="flight dump bundle or telemetry snapshot "
+                         "JSON (default: THIS process's live "
+                         "registries)")
+    pp.add_argument("--json", action="store_true",
+                    help="emit the raw report JSON")
+    pp.set_defaults(fn=cmd_perf)
 
     args = p.parse_args(argv)
     try:

@@ -35,6 +35,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import re
 import time
 
 from ..core import monitor as _cmon
@@ -43,7 +44,8 @@ from . import flight as _flight
 
 __all__ = ["is_gauge", "merge_hists", "merge_records",
            "straggler_report", "load_spool", "load_records",
-           "fleet_view", "fleet_snapshot", "top_spans"]
+           "fleet_view", "fleet_snapshot", "top_spans",
+           "slowest_program"]
 
 
 # -- counter-vs-gauge classification ---------------------------------------
@@ -53,9 +55,10 @@ __all__ = ["is_gauge", "merge_hists", "merge_records",
 # heuristic a Prometheus relabeling would encode) — kept here, in one
 # place, so the CLI and the live merge agree.
 
-_GAUGE_PREFIXES = ("mem/", "step/mem/", "flight/events",
-                   "flight/ring/", "serve/kv_blocks/",
-                   "chaos/", "sanitize/")
+_GAUGE_PREFIXES = ("mem/", "step/mem/", "step/attrib/",
+                   "flight/events", "flight/ring/",
+                   "serve/kv_blocks/", "chaos/", "sanitize/",
+                   "perf/")
 _GAUGE_SUFFIXES = ("/queue_depth", "/throughput", "/healthy",
                    "/armed", "/steps_per_dispatch")
 _GAUGE_SUBSTR = ("/last_", "/lr_e9", "last_loss", "last_time")
@@ -127,6 +130,33 @@ def top_spans(flight_tail, n=5):
              "dur_us": int(ev["dur_us"])} for ev in spans[:n]]
 
 
+# the ISSUE-16 per-program dispatch histograms — present in any spool
+# whose rank ran with PADDLE_PERF_DISPATCH on
+_DISPATCH_HIST = re.compile(r"^jit/hist/(.+)/dispatch_us$")
+
+
+def slowest_program(hists):
+    """The program that consumed the most measured dispatch time in a
+    rank's per-program histograms (max by hist sum — count × mean, not
+    a single outlier). None when the rank's spool predates the perf
+    plane or ran with dispatch timing off."""
+    best = None
+    for k, snap in (hists or {}).items():
+        m = _DISPATCH_HIST.match(k)
+        if not m or not isinstance(snap, dict) \
+                or not snap.get("count"):
+            continue
+        tot = float(snap.get("sum", 0.0))
+        if best is None or tot > best[0]:
+            best = (tot, m.group(1), snap)
+    if best is None:
+        return None
+    tot, name, snap = best
+    return {"program": name, "total_us": int(tot),
+            "count": int(snap.get("count", 0)),
+            "p50_us": round(snapshot_quantile(snap, 0.5), 1)}
+
+
 def straggler_threshold():
     """PADDLE_MONITOR_STRAGGLER_X — mean-step-time skew vs the fleet
     median above which a rank is flagged (default 1.25)."""
@@ -136,13 +166,17 @@ def straggler_threshold():
 
 def straggler_report(records, threshold=None):
     """Per-rank mean step time vs the fleet median; ranks above
-    `threshold`x median are stragglers, the slowest gets its top
-    flight spans attached (when its record carries a flight tail —
-    dump-bundle inputs do)."""
+    `threshold`x median are stragglers, each flagged rank gets its
+    top flight spans attached (when its record carries a flight tail
+    — dump-bundle inputs do) and its slowest PROGRAM (when its
+    per-program dispatch histograms are in the spool — ISSUE 16),
+    so the report names the program dragging the rank, not just the
+    span kind."""
     if threshold is None:
         threshold = straggler_threshold()
     step_ms = {}
     tails = {}
+    rank_hists = {}
     for i, rec in enumerate(records):
         rank = int(rec.get("rank", i))
         stats = rec.get("stats") or {}
@@ -152,6 +186,8 @@ def straggler_report(records, threshold=None):
                 stats.get("step/total_time_us", 0) / n / 1e3, 3)
         if rec.get("flight_tail"):
             tails[rank] = rec["flight_tail"]
+        if rec.get("hists"):
+            rank_hists[rank] = rec["hists"]
     out = {"threshold": threshold,
            "step_ms": {str(r): v for r, v in sorted(step_ms.items())},
            "median_ms": None, "stragglers": [], "slowest": None}
@@ -174,6 +210,9 @@ def straggler_report(records, threshold=None):
                      "skew": round(skew, 3)}
             if rank in tails:
                 entry["top_spans"] = top_spans(tails[rank])
+            prog = slowest_program(rank_hists.get(rank))
+            if prog is not None:
+                entry["slowest_program"] = prog
             out["stragglers"].append(entry)
     return out
 

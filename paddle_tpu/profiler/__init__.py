@@ -281,6 +281,19 @@ class Profiler:
                     "profiler/device_mem_bytes_in_use", used, ts=now)
                 _recorder.record_counter(
                     "profiler/device_mem_peak_bytes", peak, ts=now)
+            try:
+                # per-program roofline-ledger gauges (ISSUE 16): fold
+                # perf/program/<name>/{flops,bytes_accessed,...} into
+                # the counter stream so the merged Perfetto timeline
+                # shows each program's FLOP/byte ledger as ph "C"
+                # tracks next to the memory counters above
+                from ..core import monitor as _cmon
+
+                for name, value in _cmon.registry.snapshot().items():
+                    if name.startswith("perf/program/"):
+                        _recorder.record_counter(name, value, ts=now)
+            except Exception:
+                pass
         self._last_step_t = now
         self._step += 1
 
