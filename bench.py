@@ -1008,18 +1008,21 @@ def main(argv=None):
             "pallas_fusion": fusion,
         }
         # runtime sanitizer provenance (ISSUE 10): which PADDLE_SANITIZE
-        # families were armed for this run plus every sanitize/* and
-        # PTA04x/05x/06x findings counter
+        # families were armed for this run plus every sanitize/*,
+        # numerics/* (the PTA09x probe gauges) and PTA04x-09x
+        # findings counter
         from paddle_tpu.monitor import sanitize as _sanitize
 
         results["sanitize"] = {
             "armed": _sanitize.families(),
             "counters": {
                 k: v for k, v in stats.items()
-                if k.startswith(("sanitize/", "analysis/PTA04",
+                if k.startswith(("sanitize/", "numerics/",
+                                 "analysis/PTA04",
                                  "analysis/PTA05", "analysis/PTA06",
                                  "analysis/PTA07",
-                                 "analysis/PTA08"))}}
+                                 "analysis/PTA08",
+                                 "analysis/PTA09"))}}
         # serving-engine attribution (ISSUE 11): request/token
         # volumes, prefill vs decode wall time, KV-pool occupancy
         # and the eviction counts behind the serving config's
@@ -1117,7 +1120,7 @@ def main(argv=None):
     san_extra = results.get("sanitize")
     if san_extra is not None and not san_extra["armed"]:
         leaked = {k: v for k, v in san_extra["counters"].items()
-                  if k.startswith("sanitize/")
+                  if k.startswith(("sanitize/", "numerics/"))
                   and k != "sanitize/spec_errors"}
         assert not leaked, (
             "disarmed sanitizers left counters behind "

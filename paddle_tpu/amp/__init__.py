@@ -22,8 +22,12 @@ from ..core.engine import no_grad
 __all__ = ["auto_cast", "decorate", "GradScaler", "amp_guard",
            "white_list", "black_list"]
 
-# reference: imperative/amp_auto_cast.cc AmpOperators default lists
-WHITE_LIST = {"matmul", "mm", "bmm", "mv", "conv2d", "conv1d", "conv3d",
+# reference: imperative/amp_auto_cast.cc AmpOperators default lists.
+# Entries must name ops as DISPATCHED (apply_op's op_name): paddle.mm
+# and paddle.bmm both delegate to matmul before dispatch, so listing
+# them here is dead weight — audit_op_lists() (tier-1-gated) keeps
+# every entry resolvable against the live op registry.
+WHITE_LIST = {"matmul", "mv", "conv2d", "conv1d", "conv3d",
               "linear", "einsum", "addmm",
               "scaled_dot_product_attention"}
 BLACK_LIST = {"exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
@@ -35,6 +39,49 @@ BLACK_LIST = {"exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
 def white_list():
     return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST},
             "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def known_op_names():
+    """Every op name the dispatcher can actually see: the math-op
+    registry dicts plus a source scan for literal `apply_op("...")`
+    first arguments and `opname=`/`op_name=` keyword literals. This
+    is the live registry the amp lists are audited against."""
+    import os
+    import re
+
+    from ..ops import math as _math
+
+    names = set(_math._UNARY) | set(_math._BINARY) \
+        | set(_math._REDUCE)
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lit = re.compile(
+        r"""apply_op\(\s*['"](\w+)['"]|opname=['"](\w+)['"]"""
+        r"""|op_name=['"](\w+)['"]""")
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(root, fn),
+                          encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            for m in lit.finditer(src):
+                names.add(next(g for g in m.groups() if g))
+    return names
+
+
+def audit_op_lists():
+    """Stale/misspelled amp list entries: names in
+    WHITE_LIST/BLACK_LIST that resolve to NO dispatched op — amp
+    would silently never cast them (PTA002's 'check amp lists for
+    the upcast' README hint depends on these lists being live).
+    Returns {"white": [...], "black": [...]}; both empty when the
+    lists are sound (the tier-1 gate)."""
+    known = known_op_names()
+    return {"white": sorted(n for n in WHITE_LIST if n not in known),
+            "black": sorted(n for n in BLACK_LIST if n not in known)}
 
 
 def black_list():
@@ -96,6 +143,16 @@ class auto_cast:
         self._dtype = convert_dtype(dtype)
 
     def __enter__(self):
+        if self._enable and self._white:
+            # PTA092 precision audit (raises under
+            # PADDLE_SANITIZE=numerics, reports under
+            # PADDLE_ANALYSIS=1, silent disarmed): a float16 autocast
+            # whose custom white list force-lowers range-sensitive
+            # (BLACK_LIST-class) ops
+            from ..analysis.precision import audit_autocast
+
+            audit_autocast(np.dtype(self._dtype).name, self._white,
+                           where="auto_cast")
         self._prev = (_amp.enabled, _amp.dtype, _amp.level,
                       _amp.custom_white, _amp.custom_black)
         _amp.enabled = self._enable
@@ -223,8 +280,12 @@ class GradScaler:
                 # scale-event accounting: a run's snapshot shows how
                 # often dynamic scaling backed off (non-finite grads)
                 # vs grew — bench embeds these with chaos/* so an
-                # unstable run is visible in the perf record
+                # unstable run is visible in the perf record; the
+                # flight event puts the backoff on the SAME timeline
+                # as the numerics probe's sanitize_finding events, so
+                # an overflow is attributable to a tensor AND a scale
                 _monitor.stat_add("amp/scale/backoffs", 1)
+                self._record_scale_event("amp_scale_backoff")
         else:
             self._good_steps += 1
             self._bad_steps = 0
@@ -232,7 +293,16 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
                 _monitor.stat_add("amp/scale/growths", 1)
+                self._record_scale_event("amp_scale_growth")
         self._found_inf = False
+
+    def _record_scale_event(self, kind):
+        try:
+            from ..monitor import flight as _flight
+
+            _flight.record(kind, scale=float(self._scale))
+        except Exception:
+            pass  # telemetry must never break the step
 
     def _record_step(self, found_inf):
         """Compiled-path hook (jit.TrainStepCompiler(grad_scaler=...)):

@@ -52,6 +52,10 @@ from .serving import audit_block_accounting, lint_kv_source
 # quantized-collective sanitizer (ISSUE 14): PTA08x
 from . import compress
 from .compress import lint_compress_source
+# precision sanitizer (ISSUE 17): PTA09x static half
+from . import precision
+from .precision import (analyze_precision, audit_autocast,
+                        audit_train_precision, lint_numerics_source)
 
 __all__ = [
     "DIAGNOSTICS", "Finding", "Report", "Severity", "check",
@@ -66,6 +70,8 @@ __all__ = [
     "check_batch_specs", "check_replicated_params",
     "lint_kv_source", "audit_block_accounting",
     "compress", "lint_compress_source",
+    "precision", "analyze_precision", "audit_train_precision",
+    "audit_autocast", "lint_numerics_source",
 ]
 
 
@@ -91,6 +97,7 @@ def check(fn, input_spec=None, example=None, static_args=None,
     if input_spec is not None or example is not None:
         tp = trace_program(fn, input_spec=input_spec, example=example)
         analyze_dtypes(tp, report)
+        analyze_precision(tp, report)
         analyze_consts(tp, report, threshold=const_bytes_threshold)
         analyze_dead(tp, report)
         analyze_tracer_leaks(tp, report)

@@ -54,13 +54,14 @@ def iter_target_files(target):
 # PADDLE_SANITIZE); import lazily so the bare preflight CLI stays
 # light.
 SANITIZE_FAMILIES = ("donation", "locks", "sharding", "serving",
-                     "compress")
+                     "compress", "numerics")
 
 
 def _sanitize_passes(families):
     from .compress import lint_compress_source
     from .concurrency import lint_locks_source
     from .donation import lint_donation_source
+    from .precision import lint_numerics_source
     from .serving import lint_kv_source
     from .sharding import lint_sharding_source
 
@@ -68,7 +69,8 @@ def _sanitize_passes(families):
              "locks": lint_locks_source,
              "sharding": lint_sharding_source,
              "serving": lint_kv_source,
-             "compress": lint_compress_source}
+             "compress": lint_compress_source,
+             "numerics": lint_numerics_source}
     return [table[f] for f in families]
 
 
@@ -110,9 +112,10 @@ def main(argv=None):
                     metavar="FAMILIES",
                     help="also run the sanitizer static passes "
                          "(PTA04x donation, PTA05x sharding, PTA06x "
-                         "locks, PTA07x serving, PTA08x compress); "
-                         "optional comma list donation,locks,"
-                         "sharding,serving,compress (default: all)")
+                         "locks, PTA07x serving, PTA08x compress, "
+                         "PTA09x numerics); optional comma list "
+                         "donation,locks,sharding,serving,compress,"
+                         "numerics (default: all)")
     args = ap.parse_args(argv)
 
     sanitize = ()

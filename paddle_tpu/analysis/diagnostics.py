@@ -172,6 +172,39 @@ DIAGNOSTICS = {
                "scales only commute with summation over floats",
                "drop compress= for MAX/MIN/PROD and integer "
                "tensors (the op falls back to the fp32 wire)"),
+    "PTA090": (Severity.WARNING,
+               "dot/conv on half-precision operands accumulating in "
+               "half precision (no f32 preferred_element_type) — "
+               "long contractions lose mantissa bits per partial sum",
+               "pass preferred_element_type=float32 (the "
+               "bf16*bf16->f32 panel contract) and cast the result"),
+    "PTA091": (Severity.WARNING,
+               "wide reduction (sum/cumsum over >= the size "
+               "threshold) carried out in half precision",
+               "accumulate in float32 and cast the reduced result"),
+    "PTA092": (Severity.ERROR,
+               "exp/log/softmax/norm statistics computed in float16 "
+               "(e^x saturates past x~11; fp16 max 65504) — or, at "
+               "runtime, a probed tensor saturating/going non-finite",
+               "compute range statistics in float32 (or bfloat16) "
+               "and cast after"),
+    "PTA093": (Severity.ERROR,
+               "float16 master-weightless training: fp16 trainable "
+               "params stepped without a GradScaler or fp32 master "
+               "weights",
+               "pass grad_scaler=GradScaler() to the train step or "
+               "enable optimizer multi_precision"),
+    "PTA094": (Severity.ERROR,
+               "eps/literal constant underflows to zero or denormal "
+               "in the value's dtype (the 1e-12 "
+               "LayerNorm-eps-in-fp16 class)",
+               "use an eps the dtype represents (fp16: >= ~6e-8, "
+               "normal >= ~6e-5) or compute the guard in float32"),
+    "PTA095": (Severity.WARNING,
+               "cast churn: A->B->A convert round-trip — bytes (and, "
+               "narrowing, mantissa bits) spent for nothing",
+               "drop the round-trip; keep the narrow value if the "
+               "truncation was intended"),
 }
 
 

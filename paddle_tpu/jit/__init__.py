@@ -930,6 +930,10 @@ class TrainStepCompiler:
         self._guard_nonfinite = bool(guard_nonfinite
                                      or grad_scaler is not None)
         self.last_skips = 0  # nonfinite trips in the last dispatch
+        # PADDLE_SANITIZE=numerics: set at build time iff the stats
+        # probe was fused into the program (the dispatch path must
+        # match the arity the BUILD chose, not the current arming)
+        self._numerics_built = False
         self._accum_state = None
         # comm-compression state (distributed.compress): the
         # error-feedback residual buffers, donated like opt/accum
@@ -1205,11 +1209,11 @@ class TrainStepCompiler:
         n_traces0 = self._jit_cache_size() if t_d0 is not None \
             else None
         try:
-            new_p, new_opt, new_acc, new_comm, new_b, loss, skips = \
-                self._compiled(
-                    pvals, self._opt_state, self._accum_state,
-                    self._comm_state, fvals, bvals, avals, lr, rngc,
-                    self._loss_scale())
+            (new_p, new_opt, new_acc, new_comm, new_b, loss, skips,
+             nstats) = self._compiled(
+                pvals, self._opt_state, self._accum_state,
+                self._comm_state, fvals, bvals, avals, lr, rngc,
+                self._loss_scale())
         except RuntimeError as e:
             if _sanitize._donation:
                 better = _sanitize.explain_deleted(
@@ -1285,6 +1289,14 @@ class TrainStepCompiler:
             if self._grad_scaler is not None:
                 for f in flags:
                     self._grad_scaler._record_step(bool(f))
+        if self._numerics_built and nstats:
+            # numerics probe host leg (PADDLE_SANITIZE=numerics):
+            # observe() applies the sample=N cadence internally, so
+            # only every Nth dispatch pays the tiny packed-stats sync
+            from ..monitor import numerics as _numerics_mod
+
+            _numerics_mod.observe(nstats, where=self._perf_name,
+                                  step=prev)
         # K>1 returns the K per-microstep losses (shape (K,))
         return Tensor(loss, stop_gradient=True, _internal=True)
 
@@ -1482,12 +1494,35 @@ class TrainStepCompiler:
         k_merge = self._accum_steps
         k_dispatch = self._steps_per_dispatch
         guard = self._guard_nonfinite
+        # PTA093 build audit (raises under PADDLE_SANITIZE=numerics,
+        # reports under PADDLE_ANALYSIS=1, silent disarmed): fp16
+        # trainable params without a GradScaler or master weights
+        from ..analysis.precision import audit_train_precision
+
+        audit_train_precision(
+            {k: str(p._value.dtype) for k, p in t_items},
+            self._grad_scaler,
+            getattr(opt, "_multi_precision", False),
+            where=f"train_step:{type(model).__name__}")
+        # numerics probe: armed AT BUILD fuses the per-tensor stats
+        # reduction into the step; disarmed leaves nstats an empty
+        # pytree — zero extra outputs, the lowering is bit-identical
+        probe = _sanitize._numerics
+        self._numerics_built = probe
+        if probe:
+            from ..monitor import numerics as _numerics_mod
 
         def one_step(pvals, opt_state, accum, comm, fvals, bvals,
                      avals, lr, rngc, scale):
             loss, new_bvals, grads, new_comm = self._grads_and_loss(
                 loss_of, pvals, fvals, bvals, avals, rngc, scale,
                 comm)
+            # fused stats over loss/grads/params (pre-update: the
+            # values THIS step consumed) — tiny packed reductions,
+            # host-read every sample=N'th dispatch by _run_compiled
+            nstats = (_numerics_mod.stats_tree(
+                {"loss": loss, "grad": grads, "param": pvals})
+                if probe else {})
 
             if guard:
                 # fused all-finite predicate over loss + every grad
@@ -1559,7 +1594,8 @@ class TrainStepCompiler:
                 new_p, new_s, new_acc, new_b = _apply_all(None)
                 skip = ((~ok).astype(jnp.uint32) if guard
                         else jnp.uint32(0))
-            return new_p, new_s, new_acc, new_comm, new_b, loss, skip
+            return (new_p, new_s, new_acc, new_comm, new_b, loss,
+                    skip, nstats)
 
         if k_dispatch <= 1:
             step_fn = one_step
@@ -1576,15 +1612,16 @@ class TrainStepCompiler:
                 def body(carry, xs):
                     p, s, acc, cm, bv = carry
                     av, rc = xs
-                    p, s, acc, cm, bv, loss, skip = one_step(
+                    p, s, acc, cm, bv, loss, skip, ns = one_step(
                         p, s, acc, cm, fvals, bv, av, lr, rc, scale)
-                    return (p, s, acc, cm, bv), (loss, skip)
+                    return (p, s, acc, cm, bv), (loss, skip, ns)
 
                 rcs = rngc + jnp.arange(k_dispatch, dtype=jnp.uint32)
-                (p, s, acc, cm, bv), (losses, skips) = jax.lax.scan(
+                ((p, s, acc, cm, bv),
+                 (losses, skips, nstats)) = jax.lax.scan(
                     body, (pvals, opt_state, accum, comm, bvals),
                     (avals, rcs))
-                return p, s, acc, cm, bv, losses, skips
+                return p, s, acc, cm, bv, losses, skips, nstats
 
         self._compiled = self._jit_step(step_fn, trainable, frozen, bufs,
                                         batch)

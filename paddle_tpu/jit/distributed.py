@@ -449,14 +449,16 @@ class DistributedTrainStepCompiler(TrainStepCompiler):
         # inputs: (params, slots, accum, comm residuals, frozen,
         # buffers, batch, lr, rngc, loss_scale); outputs add the
         # replicated per-microstep nonfinite-skip flags after the
-        # losses
+        # losses, then the numerics-probe stats tree (empty pytree —
+        # zero leaves — unless PADDLE_SANITIZE=numerics was armed at
+        # build; `repl` is a pytree prefix, so it covers both)
         in_shardings = (param_sh, self._slot_shardings,
                         self._accum_shardings, self._comm_shardings,
                         frozen_sh, buf_sh, tuple(batch_sh), repl,
                         repl, repl)
         out_shardings = (param_sh, self._slot_shardings,
                         self._accum_shardings, self._comm_shardings,
-                        buf_sh, repl, repl)
+                        buf_sh, repl, repl, repl)
         donate = (0, 1, 2, 3) if self._donate else ()
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)

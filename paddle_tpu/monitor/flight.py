@@ -393,6 +393,15 @@ def _sanitize_section():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _numerics_section():
+    try:
+        from . import numerics
+
+        return numerics.describe()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def write_dump(reason, extra=None, path=None, full_memory=None):
     """Write one self-contained JSON forensics bundle and return its
     path. Schema (DUMP_SCHEMA = "paddle_tpu.flight/1"):
@@ -440,6 +449,10 @@ def write_dump(reason, extra=None, path=None, full_memory=None):
         # what they tracked/found — sanitize_arm/sanitize_finding
         # events ride the flight_tail, this is the summary
         "sanitize": _sanitize_section(),
+        # numerics-probe state (ISSUE 17): freshest per-tensor
+        # absmax/absmin/nonfinite stats — an overflow in this bundle
+        # names the offending tensor, not just the skipped step
+        "numerics": _numerics_section(),
     }
     try:
         from . import telemetry_snapshot

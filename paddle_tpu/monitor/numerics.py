@@ -1,0 +1,196 @@
+"""paddle_tpu.monitor.numerics — runtime numerics probe (PTA09x).
+
+The runtime half of the precision sanitizer (static half:
+`analysis/precision.py`). Under `PADDLE_SANITIZE=numerics[:sample=N]
+[:absmax=T]` the TrainStepCompiler fuses a per-tensor stats reduction
+— absmax over finite values, smallest nonzero magnitude, non-finite
+count — over loss/grads/params into the compiled step (riding the
+same build hook as `guard_nonfinite`, so the DISARMED lowering is
+bit-identical: the probe slot is an empty pytree that adds zero
+outputs). Every Nth dispatch the host reads the tiny packed stats
+and feeds:
+
+  * gauges    numerics/<tree>/absmax, .../absmin_nonzero
+  * counters  numerics/<tree>/saturated, .../nonfinite
+  * histogram numerics/hist/absmax (distribution over observations)
+  * findings  PTA092 via sanitize._emit — `sanitize_finding` flight
+    events name the OFFENDING TENSOR, so an overflow in a dump
+    bundle is attributable to `grad/linear.w`, not just a skipped
+    step; GradScaler growth/backoff events annotate the same
+    timeline
+
+Params (spec or env): `sample=N` host-readback cadence (default
+$PADDLE_NUMERICS_SAMPLE or 1 — the device-side stats are fused and
+cheap; sampling bounds only the host sync), `absmax=T` saturation
+threshold (default $PADDLE_NUMERICS_ABSMAX or 0.9*65504, fp16's
+ceiling with headroom).
+
+Dispatch-time findings REPORT (counters + flight + stderr), they
+never raise — aborting mid-training belongs to guard_nonfinite;
+build-time audits (PTA093 master-weightless fp16) are the raising
+half, in analysis/precision.py.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core import monitor as _cmon
+from . import sanitize as _sanitize
+
+__all__ = ["armed", "sample_every", "absmax_threshold", "stats_tree",
+           "observe", "describe", "clear"]
+
+_FP16_MAX = 65504.0
+
+# last host-read stats per tensor, bounded — dump-bundle section
+_last: OrderedDict = OrderedDict()
+_LAST_MAX = 256
+_n_observed = 0
+_lock = threading.Lock()
+
+
+def armed():
+    """Hot-path gate (one module-attribute read, the house rule)."""
+    return _sanitize._numerics
+
+
+def _param(name, env, default):
+    opts = _sanitize._opts.get("numerics", {})
+    if name in opts:
+        return float(opts[name])
+    raw = os.environ.get(env, "")
+    try:
+        return float(raw) if raw else float(default)
+    except ValueError:
+        return float(default)
+
+
+def sample_every():
+    """Host-readback cadence: observe() syncs every Nth call."""
+    return max(1, int(_param("sample", "PADDLE_NUMERICS_SAMPLE", 1)))
+
+
+def absmax_threshold():
+    """|x| above this reports saturation risk (PTA092)."""
+    return _param("absmax", "PADDLE_NUMERICS_ABSMAX",
+                  0.9 * _FP16_MAX)
+
+
+def stats_tree(tree):
+    """TRACED: fuse a (3,)-f32 stats vector per floating leaf of a
+    nested dict/list/tuple tree — [absmax over finite values,
+    smallest nonzero finite magnitude (0 if none), non-finite
+    count]. Returns {joined/path: (3,) array}; empty and non-float
+    leaves are skipped so the probe never perturbs dtypes."""
+    import jax.numpy as jnp
+
+    out = {}
+
+    def leaf(path, x):
+        if not hasattr(x, "dtype") or np.size(x) == 0 \
+                or not jnp.issubdtype(x.dtype, jnp.floating):
+            return
+        finite = jnp.isfinite(x)
+        a = jnp.abs(x.astype(jnp.float32))
+        absmax = jnp.max(jnp.where(finite, a, 0.0))
+        pos = jnp.where(finite & (a > 0), a, jnp.inf)
+        absmin = jnp.min(pos)
+        absmin = jnp.where(jnp.isfinite(absmin), absmin,
+                           jnp.float32(0.0))
+        nonfinite = jnp.sum(~finite).astype(jnp.float32)
+        out[path] = jnp.stack([absmax, absmin, nonfinite])
+
+    def walk(path, obj):
+        if isinstance(obj, dict):
+            for k in sorted(obj):
+                walk(f"{path}/{k}" if path else str(k), obj[k])
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk(f"{path}/{i}" if path else str(i), v)
+        elif obj is not None:
+            leaf(path, obj)
+
+    walk("", tree)
+    return out
+
+
+def observe(stats, where="train_step", step=0):
+    """Host leg: reduce one dispatch's packed stats (leaves may be
+    (3,) or scan-stacked (K, 3)) into gauges/counters/findings.
+    Applies the `sample=N` cadence internally — callers invoke it
+    every dispatch, the sync happens every Nth. Returns the reduced
+    {name: (absmax, absmin_nonzero, nonfinite)} dict on sampled
+    calls, None on skipped ones."""
+    global _n_observed
+    if not stats:
+        return None
+    with _lock:
+        _n_observed += 1
+        n = _n_observed
+    if (n - 1) % sample_every():
+        return None
+    thr = absmax_threshold()
+    reduced = {}
+    for name, v in stats.items():
+        arr = np.asarray(v, np.float32).reshape(-1, 3)
+        absmax = float(arr[:, 0].max())
+        mins = arr[:, 1][arr[:, 1] > 0]
+        absmin = float(mins.min()) if mins.size else 0.0
+        nonfinite = int(arr[:, 2].sum())
+        reduced[name] = (absmax, absmin, nonfinite)
+        _cmon.stat_set(f"numerics/{name}/absmax",
+                       int(np.ceil(absmax)))
+        _cmon.hist_observe("numerics/hist/absmax", absmax)
+        if nonfinite:
+            _cmon.stat_add(f"numerics/{name}/nonfinite", nonfinite)
+            _sanitize._emit(
+                "PTA092",
+                f"{where} step {step}: {nonfinite} non-finite "
+                f"value(s) in tensor '{name}' (absmax of the finite "
+                f"part {absmax:.6g}) — the overflow originates HERE, "
+                "not merely in the skipped step",
+                dedup=f"numerics:nonfinite:{name}")
+        elif absmax > thr:
+            _cmon.stat_add(f"numerics/{name}/saturated", 1)
+            _sanitize._emit(
+                "PTA092",
+                f"{where} step {step}: tensor '{name}' absmax "
+                f"{absmax:.6g} exceeds the saturation threshold "
+                f"{thr:.6g} — headed for fp16 overflow (max "
+                f"{_FP16_MAX:g}); rescale or keep it in f32",
+                dedup=f"numerics:saturated:{name}")
+    with _lock:
+        for name, vals in reduced.items():
+            _last[name] = {"absmax": vals[0],
+                           "absmin_nonzero": vals[1],
+                           "nonfinite": vals[2], "step": int(step)}
+            _last.move_to_end(name)
+        while len(_last) > _LAST_MAX:
+            _last.popitem(last=False)
+    return reduced
+
+
+def describe():
+    """JSON-able snapshot for flight dump bundles: what the probe was
+    watching and the freshest per-tensor stats when the incident
+    hit."""
+    with _lock:
+        last = {k: dict(v) for k, v in _last.items()}
+        n = _n_observed
+    return {"armed": bool(_sanitize._numerics),
+            "sample": sample_every() if _sanitize._numerics else None,
+            "absmax_threshold": (absmax_threshold()
+                                 if _sanitize._numerics else None),
+            "observations": n, "last": last}
+
+
+def clear():
+    """Reset observation state (tests)."""
+    global _n_observed
+    with _lock:
+        _last.clear()
+        _n_observed = 0

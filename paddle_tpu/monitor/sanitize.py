@@ -39,9 +39,17 @@ Families (PADDLE_SANITIZE, `,`/`;`-separated, chaos-style grammar):
                 `audit_leaks(live)` / `LLMEngine.check_drained()`
                 report PTA070 for blocks still owned by requests
                 the engine no longer tracks.
+    numerics    precision sanitizer (PTA09x): the TrainStepCompiler
+                fuses a per-tensor absmax/absmin/nonfinite stats
+                probe over loss/grads/params (host-read every
+                `sample=N`th dispatch, saturation threshold
+                `absmax=T`) and the build-time precision audits —
+                fp16 master-weightless training (PTA093), fp16
+                autocast of range-sensitive ops (PTA092) — RAISE.
     all / 1     every family.
 
     e.g.  PADDLE_SANITIZE=donation;locks:hold_ms=250
+          PADDLE_SANITIZE=numerics:sample=10:absmax=30000
 
 Zero-overhead contract (the chaos `_armed` pattern): with nothing
 armed every hook gates on a module-attribute boolean
@@ -92,11 +100,22 @@ FAMILIES = {
                 "residual never donated (PTA080), quantized "
                 "allreduce on a non-SUM op / integer dtype "
                 "(PTA081) — error findings raise",
+    "numerics": "precision sanitizer (PTA09x): TrainStepCompiler "
+                "fuses a per-tensor absmax/absmin/nonfinite stats "
+                "probe over loss/grads/params and the build-time "
+                "precision audits (fp16 master-weightless training, "
+                "fp16 autocast of range-sensitive ops) raise",
 }
 
 PARAMS = {
     "hold_ms": "locks: flag a lock held longer than this many "
                "milliseconds (PTA061; default 1000)",
+    "sample": "numerics: host-readback cadence — observe the fused "
+              "stats every Nth dispatch (default "
+              "$PADDLE_NUMERICS_SAMPLE or 1)",
+    "absmax": "numerics: saturation threshold — |x| above this "
+              "reports PTA092 (default $PADDLE_NUMERICS_ABSMAX or "
+              "0.9*65504)",
 }
 
 # hot-path gates — one module-attribute read per call site
@@ -106,6 +125,7 @@ _locks = False
 _sharding = False
 _serving = False
 _compress = False
+_numerics = False
 _spec = ""
 _opts: dict = {}
 
@@ -268,7 +288,7 @@ def configure(spec=None):
     Replaces any previous configuration; empty/unset disarms. Returns
     the armed {family: params} map."""
     global _armed, _donation, _locks, _sharding, _serving, \
-        _compress, _spec, _opts
+        _compress, _numerics, _spec, _opts
     if spec is None:
         spec = os.environ.get("PADDLE_SANITIZE", "")
     fams = parse_spec(spec) if spec else {}
@@ -278,6 +298,7 @@ def configure(spec=None):
     _sharding = "sharding" in fams
     _serving = "serving" in fams
     _compress = "compress" in fams
+    _numerics = "numerics" in fams
     _armed = bool(fams)
     _spec = str(spec) if fams else ""
     if fams:
@@ -297,9 +318,9 @@ def configure(spec=None):
 
 def disarm():
     global _armed, _donation, _locks, _sharding, _serving, \
-        _compress, _spec, _opts
+        _compress, _numerics, _spec, _opts
     _armed = _donation = _locks = _sharding = _serving = \
-        _compress = False
+        _compress = _numerics = False
     _spec = ""
     _opts = {}
     # zero the gauge only if arming ever created it — stat_get/set

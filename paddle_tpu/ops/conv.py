@@ -105,19 +105,19 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
     df = "NWC" if data_format in ("NLC",) else "NCW"
     return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups,
-                    df, "conv1d")
+                    df, opname="conv1d")
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
     return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
-                    data_format, "conv2d")
+                    data_format, opname="conv2d")
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
     return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
-                    data_format, "conv3d")
+                    data_format, opname="conv3d")
 
 
 def _k_conv_transpose(x, w, bias, stride, padding, dilation, groups, dn,
@@ -173,7 +173,7 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     df = "NWC" if data_format == "NLC" else "NCW"
     return _conv_transpose_nd(1, x, weight, bias, stride, padding,
                               output_padding, dilation, groups, df,
-                              "conv1d_transpose")
+                              opname="conv1d_transpose")
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -181,7 +181,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCHW", name=None):
     return _conv_transpose_nd(2, x, weight, bias, stride, padding,
                               output_padding, dilation, groups, data_format,
-                              "conv2d_transpose")
+                              opname="conv2d_transpose")
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -189,7 +189,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCDHW", name=None):
     return _conv_transpose_nd(3, x, weight, bias, stride, padding,
                               output_padding, dilation, groups, data_format,
-                              "conv3d_transpose")
+                              opname="conv3d_transpose")
 
 
 # -- pooling ------------------------------------------------------------

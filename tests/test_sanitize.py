@@ -88,8 +88,9 @@ def test_configure_env_default(monkeypatch):
 
 def _sanitize_counters():
     return {k: v for k, v in registry.snapshot().items()
-            if k.startswith(("sanitize/", "analysis/PTA04",
-                             "analysis/PTA05", "analysis/PTA06"))}
+            if k.startswith(("sanitize/", "numerics/",
+                             "analysis/PTA04", "analysis/PTA05",
+                             "analysis/PTA06", "analysis/PTA09"))}
 
 
 def test_disarmed_dispatch_adds_zero_counters():
