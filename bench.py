@@ -216,6 +216,36 @@ def bench_mnist(on_tpu):
     finally:
         mgr.close()
         shutil.rmtree(ck_dir, ignore_errors=True)
+
+    # live introspection tax (ISSUE 18): the SAME plain step loop
+    # with the debug server armed on an ephemeral port — the delta
+    # vs the plain loop proves the serve thread is off the hot path
+    # (an idle accept() should be unmeasurable; measured, not
+    # assumed)
+    from paddle_tpu.monitor import server as _mserver
+
+    srv = None
+    try:
+        srv = _mserver.serve(port=0, host="127.0.0.1")
+    except OSError:
+        pass
+    if srv is not None:
+        try:
+            for _ in range(warmup):
+                loss = step(x, y)
+            dts_s = []
+            for _ in range(WINDOWS):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = step(x, y)
+                float(loss.item())  # sync
+                dts_s.append((time.perf_counter() - t0) / steps)
+            dt_s = float(np.median(dts_s))
+            r["serve_port"] = srv.port
+            r["serve_imgs_s"] = round(batch / dt_s, 1)
+            r["serve_overhead_pct"] = round((dt_s / dt - 1) * 100, 2)
+        finally:
+            _mserver.stop_server()
     return r
 
 

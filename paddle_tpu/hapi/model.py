@@ -431,6 +431,11 @@ class Model:
         # =1) get the collective/compile watchdog + crash-bundle
         # excepthook armed before the first step
         _flight.maybe_auto_arm("hapi.Model.fit")
+        # live introspection: PADDLE_MONITOR_SERVE=<port> exposes
+        # /metrics, /statusz, /flightz, ... for the run's lifetime
+        from ..monitor import server as _mserver
+
+        _mserver.maybe_auto_serve("hapi.Model.fit")
         accum = max(1, int(accumulate_grad_batches))
         self._fit_accum = accum
         self._accum_seen = 0  # never inherit a partial eager window

@@ -166,6 +166,16 @@ class LLMEngine:
         # emergency drain-and-export landing zone (incident hook)
         self.emergency_exports = None
         self._incident_armed = False
+        # live introspection (/tracez): register this engine's trace
+        # spool weakly — a collected engine simply drops off the
+        # page; total fallback because a debug surface must never
+        # fail engine construction
+        try:
+            from ...monitor import server as _mserver
+
+            _mserver.add_trace_source(self.export_traces)
+        except Exception:
+            pass
 
     # -- request intake ----------------------------------------------
     def add_request(self, prompt_ids, sampling=None, on_token=None,

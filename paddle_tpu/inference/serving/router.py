@@ -148,6 +148,11 @@ class Router:
         # exports that could not be replaced anywhere (no healthy
         # replica left) — retained, never silently dropped
         self.orphan_exports = []
+        # live introspection: PADDLE_MONITOR_SERVE=<port> exposes
+        # /metrics, /tracez, ... for the router's lifetime
+        from ...monitor import server as _mserver
+
+        _mserver.maybe_auto_serve("serving.Router")
         self._replicas = []
         for i in range(n):
             # every replica after the first warm-boots off the

@@ -46,6 +46,15 @@ tooling around them):
     PADDLE_CHAOS spec and observed through chaos/* counters + flight
     events. See chaos.py and the README "Chaos testing & resilience"
     section.
+
+  * server (submodule) — the PULL side (ISSUE 18): an in-process
+    debug/metrics HTTP server (`monitor.serve(port=0)`, env-armed by
+    PADDLE_MONITOR_SERVE from Model.fit / the serving Router) whose
+    /metrics page shares prometheus_text() with the exporter, plus
+    live /statusz /flightz /memz /perfz /tracez pages and /profilez
+    on-demand capture; `python -m paddle_tpu.monitor scrape` pulls N
+    ranks' pages into the fleet straggler report. See server.py and
+    the README "Live introspection" section.
 """
 from __future__ import annotations
 
@@ -67,6 +76,10 @@ from . import chaos  # noqa: E402 — deterministic fault injection
 from . import sanitize  # noqa: E402 — runtime sanitizer core (ISSUE 10)
 from . import trace  # noqa: E402 — per-request serving traces (ISSUE 15)
 from . import fleet  # noqa: E402 — fleet aggregation + stragglers
+from . import server  # noqa: E402 — live introspection plane (ISSUE 18)
+from .server import (  # noqa: F401 — the pull-side lifecycle surface
+    serve, get_server, stop_server, maybe_auto_serve,
+)
 
 __all__ = [
     "StatValue", "StatRegistry", "Histogram", "registry", "stat_add",
@@ -74,8 +87,10 @@ __all__ = [
     "snapshot_quantile", "VLOG", "vlog_level",
     "device_memory_stats", "device_memory_in_use", "StepTimer",
     "MetricsExporter", "start_exporter", "stop_exporter",
-    "get_exporter", "telemetry_snapshot", "fleet_snapshot", "flight",
-    "memory", "perf", "chaos", "trace", "fleet",
+    "get_exporter", "telemetry_snapshot", "fleet_snapshot",
+    "prometheus_text", "serve", "get_server", "stop_server",
+    "maybe_auto_serve", "flight",
+    "memory", "perf", "chaos", "trace", "fleet", "server",
 ]
 
 
@@ -291,62 +306,102 @@ class StepTimer:
 
 
 # ---------------------------------------------------------------------------
-# Metrics exporter
+# Prometheus exposition (ONE renderer: exporter textfile + /metrics)
 # ---------------------------------------------------------------------------
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+# the series suffixes a Prometheus histogram family OWNS — a scalar
+# whose sanitized name lands on `<hist>_bucket`/`_sum`/`_count` would
+# alias the histogram's own series just as hard as a same-name scalar
+_PROM_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 def _prom_name(name):
     return "paddle_tpu_" + _PROM_BAD.sub("_", name)
 
 
-def _prom_lines(items):
-    """Prometheus exposition lines for (name, value) pairs. The `_`
-    substitution is lossy (`step/time` and `step_time` both sanitize
-    to `paddle_tpu_step_time`), so when several stat names land on one
-    metric name EVERY collider gets a suffix derived (sha1) from its
-    ORIGINAL name — no two stats ever alias one Prometheus series.
-    The suffix itself is a pure function of the name; WHETHER a name
-    needs one depends on the name set in the snapshot, which only
-    grows within a process (stat_reset zeroes, never removes) and is
-    identical across ranks running the same code — so series names
-    stay stable except at the moment a brand-new collider first
-    registers."""
+def _prom_escape(v):
+    """Prometheus label-value escaping (backslash, double quote,
+    newline — the exposition-format contract). ONE escaper for every
+    label either leg of the renderer emits, so user-supplied names
+    riding a label can never produce an unparsable or aliasing
+    line."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_value(v):
+    """One sample value, Prometheus-spelled: bools as 0/1, non-finite
+    floats as NaN/+Inf/-Inf (valid exposition tokens — `nan`/`inf`
+    Python spellings are not), everything else as-is."""
+    import math
+
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(v)
+
+
+def _prom_resolve(stat_names, hist_names):
+    """Final metric base name for every input, computed over the
+    UNION of both families. The `_` substitution is lossy
+    (`step/time` and `step_time` both sanitize to
+    `paddle_tpu_step_time`), so when several names land on one metric
+    name EVERY collider gets a suffix derived (sha1) from its
+    ORIGINAL name — no two stats, no stat-vs-histogram pair, and no
+    stat-vs-`_bucket`/`_sum`/`_count` pair ever alias one Prometheus
+    series. The suffix itself is a pure function of the name; WHETHER
+    a name needs one depends on the name set in the snapshot, which
+    only grows within a process (stat_reset zeroes, never removes)
+    and is identical across ranks running the same code — so series
+    names stay stable except at the moment a brand-new collider first
+    registers. Returns {("stat"|"hist", original_name): metric}."""
     import hashlib
 
-    sanitized = [(_prom_name(k), k, v) for k, v in items]
+    keys = [("stat", k) for k in stat_names] \
+        + [("hist", k) for k in hist_names]
+    sanitized = {key: _prom_name(key[1]) for key in keys}
     counts = {}
-    for m, _, _ in sanitized:
+    for m in sanitized.values():
         counts[m] = counts.get(m, 0) + 1
+    hist_series = {sanitized[key] + suf for key in keys
+                   if key[0] == "hist"
+                   for suf in _PROM_HIST_SUFFIXES}
+    out = {}
+    for key in keys:
+        m = sanitized[key]
+        if counts[m] > 1 or (key[0] == "stat" and m in hist_series):
+            m = f"{m}_{hashlib.sha1(key[1].encode()).hexdigest()[:6]}"
+        out[key] = m
+    return out
+
+
+def _prom_render(items, hists):
+    """The full exposition: scalar lines for (name, value) pairs plus
+    classic histogram families — `<name>_bucket{le=...}` cumulative
+    series with `_sum`/`_count`, one `le` per OCCUPIED bucket's upper
+    edge (sparse inputs stay sparse on the wire; cumulative semantics
+    make skipped empty buckets exactly equivalent) and the mandatory
+    `+Inf` terminal. Overflow observations only appear in `+Inf`, as
+    they exceed every finite boundary. ONE renderer — the
+    MetricsExporter `.prom` textfile and the debug server's /metrics
+    page both call this, so the two surfaces can never disagree on a
+    series name."""
+    names = _prom_resolve([k for k, _ in items], hists)
     lines = []
-    for m, k, v in sanitized:
-        if counts[m] > 1:
-            m = f"{m}_{hashlib.sha1(k.encode()).hexdigest()[:6]}"
-        lines.append(f"{m} {v}")
-    return lines
-
-
-def _prom_hist_lines(hists):
-    """Prometheus histogram exposition for {name: Histogram.snapshot()}
-    — the classic `<name>_bucket{le=...}` cumulative series plus
-    `_sum`/`_count`, one `le` per OCCUPIED bucket's upper edge (sparse
-    inputs stay sparse on the wire; cumulative semantics make skipped
-    empty buckets exactly equivalent) with the mandatory `+Inf`
-    terminal. Overflow observations only appear in `+Inf`, as they
-    exceed every finite boundary."""
-    import hashlib
-
-    counts = {}
-    for name in hists:
-        m = _prom_name(name)
-        counts[m] = counts.get(m, 0) + 1
-    lines = []
+    for k, v in items:
+        lines.append(f"{names[('stat', k)]} {_prom_value(v)}")
     for name in sorted(hists):
         s = hists[name]
-        m = _prom_name(name)
-        if counts[m] > 1:   # the _prom_lines anti-aliasing discipline
-            m = f"{m}_{hashlib.sha1(name.encode()).hexdigest()[:6]}"
+        m = names[("hist", name)]
         lo = float(s["lo"])
         pd = int(s["per_decade"])
         nb = pd * int(s["decades"])
@@ -358,11 +413,31 @@ def _prom_hist_lines(hists):
             if idx > nb:
                 continue  # overflow folds into +Inf below
             le = lo * 10.0 ** (idx / pd) if idx else lo
-            lines.append(f'{m}_bucket{{le="{le:.6g}"}} {cum}')
+            lines.append(
+                f'{m}_bucket{{le="{_prom_escape(f"{le:.6g}")}"}} '
+                f'{cum}')
         lines.append(f'{m}_bucket{{le="+Inf"}} {int(s["count"])}')
         lines.append(f'{m}_sum {float(s["sum"]):.6g}')
         lines.append(f'{m}_count {int(s["count"])}')
     return lines
+
+
+def prometheus_text(snap=None):
+    """Prometheus exposition text for a telemetry snapshot (the live
+    one when None) — the single formatter behind both pull
+    (`/metrics` on the debug server) and push (the exporter's `.prom`
+    textfile), per the one-renderer discipline."""
+    if snap is None:
+        snap = telemetry_snapshot()
+    items = sorted((snap.get("stats") or {}).items())
+    items.append(("export_timestamp_seconds", snap.get("ts", 0)))
+    lines = _prom_render(items, snap.get("hists") or {})
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Metrics exporter
+# ---------------------------------------------------------------------------
 
 
 class MetricsExporter:
@@ -410,12 +485,8 @@ class MetricsExporter:
                 f.write(json.dumps(snap) + "\n")
         else:
             tmp = f"{path}.tmp.{os.getpid()}"
-            items = sorted(snap["stats"].items())
-            items.append(("export_timestamp_seconds", snap["ts"]))
-            lines = _prom_lines(items)
-            lines += _prom_hist_lines(snap.get("hists") or {})
             with open(tmp, "w") as f:
-                f.write("\n".join(lines) + "\n")
+                f.write(prometheus_text(snap))
             os.replace(tmp, path)
         return snap
 

@@ -48,6 +48,19 @@ artifacts the runtime leaves behind:
       spool carries per-program dispatch histograms — their
       slowest program).
 
+  serve [port] [--host H]
+      Run THIS process's live introspection server (monitor.server)
+      in the foreground until interrupted — the debug pages over an
+      otherwise-idle process, mostly for smoke tests and scrape
+      development. Real jobs arm via PADDLE_MONITOR_SERVE instead.
+
+  scrape host:port ... [--json] [--threshold X] [--timeout S]
+      The `fleet` report against RUNNING processes: pull each
+      target's /metrics?format=json (+ /statusz, /flightz) and run
+      the same merge + straggler detection the bundle-driven path
+      uses. Unreachable targets degrade to a partial report with
+      exit 1 (exit 2 when nothing answers).
+
   perf [bundle.json] [--json]
       Roofline attribution (ISSUE 16): the perf/program/* cost
       ledger joined with measured dispatch histograms into
@@ -211,6 +224,9 @@ def _memory_lines(mem):
     out = []
     if mem.get("error"):
         return [f"memory: unavailable ({mem['error']})"]
+    if mem.get("uninitialized"):
+        return ["memory: no jax backend initialized yet (the report "
+                "never initializes one itself)"]
     dev = mem.get("device") or {}
     out.append(f"memory ({dev.get('source', '?')}): "
                f"allocated {_fmt_bytes(dev.get('allocated_bytes'))}, "
@@ -615,21 +631,17 @@ def cmd_trace(args):
 # fleet (multi-rank telemetry merge + straggler report)
 # ---------------------------------------------------------------------------
 
-def cmd_fleet(args):
-    from . import fleet as fleet_mod
+def _fleet_lines(view, show_all=False, noun="artifact"):
+    """Text rendering of a fleet view — ONE renderer for both the
+    bundle-driven `fleet` path and the live `scrape` path, so the
+    straggler report reads identically however the records arrived."""
     from ..core.monitor import snapshot_quantile
 
-    view = fleet_mod.fleet_view(args.artifacts,
-                                threshold=args.threshold)
-    if args.json:
-        json.dump(view, sys.stdout, indent=2, default=str)
-        sys.stdout.write("\n")
-        return 0
     out = [f"fleet view over ranks {view['ranks']} "
-           f"({len(view['sources'])} artifact(s))"]
+           f"({len(view['sources'])} {noun}(s))"]
     counters = view.get("counters") or {}
     keys = sorted(k for k in counters
-                  if args.all or k.startswith(
+                  if show_all or k.startswith(
                       ("step/", "serve/", "comm/", "io/", "jit/")))
     if keys:
         out.append("")
@@ -639,7 +651,7 @@ def cmd_fleet(args):
             out.append(f"  {k} = {counters[k]}")
     gauges = view.get("gauges") or {}
     gkeys = sorted(k for k in gauges
-                   if args.all or k.startswith(
+                   if show_all or k.startswith(
                        ("step/", "serve/", "mem/")))
     if gkeys:
         out.append("")
@@ -697,8 +709,67 @@ def cmd_fleet(args):
     else:
         out.append("no step/count in any artifact — straggler "
                    "detection needs step telemetry")
-    print("\n".join(out))
+    return out
+
+
+def cmd_fleet(args):
+    from . import fleet as fleet_mod
+
+    view = fleet_mod.fleet_view(args.artifacts,
+                                threshold=args.threshold)
+    if args.json:
+        json.dump(view, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+        return 0
+    print("\n".join(_fleet_lines(view, show_all=args.all)))
     return 0
+
+
+# ---------------------------------------------------------------------------
+# serve / scrape (the live introspection plane, ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def cmd_serve(args):
+    from . import server as server_mod
+
+    # a taken port propagates as OSError into main()'s exit-2 path
+    srv = server_mod.serve(port=args.port, host=args.host)
+    print(f"serving on {srv.url} — routes: "
+          + " ".join(p for p, _, _ in server_mod.ROUTES))
+    sys.stdout.flush()
+    import time
+
+    try:
+        while srv.running():
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server_mod.stop_server()
+    return 0
+
+
+def cmd_scrape(args):
+    from . import fleet as fleet_mod
+
+    records, failures = fleet_mod.scrape_records(
+        args.targets, timeout=args.timeout,
+        with_flight=not args.no_flight)
+    for t in failures:
+        print(f"scrape: {t}: {failures[t]}", file=sys.stderr)
+    if not records:
+        print("error: no scrape target reachable", file=sys.stderr)
+        return 2
+    view = fleet_mod.scrape_view(records, threshold=args.threshold)
+    if args.json:
+        json.dump(view, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        print("\n".join(_fleet_lines(view, show_all=args.all,
+                                     noun="target")))
+    # Router-heartbeat semantics: a half-dead fleet still reports,
+    # but the exit code says it was partial
+    return 1 if failures else 0
 
 
 # ---------------------------------------------------------------------------
@@ -710,8 +781,9 @@ def main(argv=None):
                     "flight dump bundles, merge per-rank chrome "
                     "traces, summarize exporter metrics trails, "
                     "report live memory, render per-request serving "
-                    "traces, and merge fleet telemetry with "
-                    "straggler detection.")
+                    "traces, merge fleet telemetry with straggler "
+                    "detection, and serve/scrape the live "
+                    "introspection plane.")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     pi = sub.add_parser(
@@ -801,6 +873,41 @@ def main(argv=None):
                     help="show every merged counter, not just the "
                          "step/serve/comm/io/jit families")
     pf.set_defaults(fn=cmd_fleet)
+
+    ps = sub.add_parser(
+        "serve",
+        help="run THIS process's live introspection server in the "
+             "foreground (mostly for smoke tests; real jobs arm via "
+             "PADDLE_MONITOR_SERVE)")
+    ps.add_argument("port", nargs="?", type=int, default=0,
+                    help="port to bind (default 0 = ephemeral)")
+    ps.add_argument("--host", default=None,
+                    help="bind address (default "
+                         "PADDLE_MONITOR_SERVE_HOST or 0.0.0.0)")
+    ps.set_defaults(fn=cmd_serve)
+
+    psc = sub.add_parser(
+        "scrape",
+        help="pull /metrics+/statusz from running debug servers and "
+             "run the fleet merge + straggler report live")
+    psc.add_argument("targets", nargs="+",
+                     help="host:port of each rank's debug server")
+    psc.add_argument("--json", action="store_true",
+                     help="emit the merged fleet view as JSON")
+    psc.add_argument("--threshold", type=float, default=None,
+                     help="straggler skew threshold vs the fleet "
+                          "median (default "
+                          "PADDLE_MONITOR_STRAGGLER_X=1.25)")
+    psc.add_argument("--all", action="store_true",
+                     help="show every merged counter, not just the "
+                          "step/serve/comm/io/jit families")
+    psc.add_argument("--timeout", type=float, default=5.0,
+                     help="per-request timeout in seconds "
+                          "(default 5)")
+    psc.add_argument("--no-flight", action="store_true",
+                     help="skip the /flightz pull (straggler span "
+                          "attribution) — faster, deterministic")
+    psc.set_defaults(fn=cmd_scrape)
 
     pp = sub.add_parser(
         "perf",

@@ -45,7 +45,7 @@ from . import flight as _flight
 __all__ = ["is_gauge", "merge_hists", "merge_records",
            "straggler_report", "load_spool", "load_records",
            "fleet_view", "fleet_snapshot", "top_spans",
-           "slowest_program"]
+           "slowest_program", "scrape_records", "scrape_view"]
 
 
 # -- counter-vs-gauge classification ---------------------------------------
@@ -75,16 +75,36 @@ def is_gauge(name):
 
 
 def merge_hists(snaps):
-    """Bucket-merge Histogram.snapshot() dicts (all must share
-    boundaries). Returns a merged snapshot dict, or None for no
-    inputs."""
-    snaps = [s for s in snaps if s]
-    if not snaps:
-        return None
-    base = Histogram(lo=float(snaps[0]["lo"]),
-                     per_decade=int(snaps[0]["per_decade"]),
-                     decades=int(snaps[0]["decades"]))
+    """Bucket-merge Histogram.snapshot() dicts. Returns a merged
+    snapshot dict, or None for no (usable) inputs. A LIVE fleet is
+    allowed to be mixed-schema — a rank relaunched with different
+    histogram-config knobs (PADDLE_MONITOR_HIST_LO and siblings), or
+    a spool predating a boundary
+    change, must degrade (majority-schema merge + a skip counter)
+    rather than crash the whole straggler report: snaps are grouped
+    by boundary config, the group holding the most observations
+    merges, the rest count under monitor/fleet/hist_schema_skips."""
+    groups = {}
     for s in snaps:
+        if not isinstance(s, dict):
+            continue
+        try:
+            key = (float(s["lo"]), int(s["per_decade"]),
+                   int(s["decades"]))
+        except (KeyError, TypeError, ValueError):
+            _cmon.stat_add("monitor/fleet/hist_schema_skips", 1)
+            continue
+        groups.setdefault(key, []).append(s)
+    if not groups:
+        return None
+    key = max(groups, key=lambda k: (
+        sum(int(s.get("count", 0)) for s in groups[k]),
+        len(groups[k])))
+    skipped = sum(len(v) for k, v in groups.items() if k != key)
+    if skipped:
+        _cmon.stat_add("monitor/fleet/hist_schema_skips", skipped)
+    base = Histogram(lo=key[0], per_decade=key[1], decades=key[2])
+    for s in groups[key]:
         base.merge(s)
     return base.snapshot()
 
@@ -100,7 +120,11 @@ def merge_records(records):
     hist_by_name = {}
     for rec, rank in zip(records, ranks):
         for k, v in (rec.get("stats") or {}).items():
-            if is_gauge(k):
+            # non-numeric values (a mixed-schema spool smuggling
+            # strings into the stat namespace) cannot sum — keep
+            # them visible per-rank instead of crashing the merge
+            if is_gauge(k) or isinstance(v, str) \
+                    or not isinstance(v, (int, float)):
                 gauges.setdefault(k, {})[str(rank)] = v
             else:
                 counters[k] = counters.get(k, 0) + v
@@ -111,7 +135,9 @@ def merge_records(records):
         merged = merge_hists([s for _, s in pairs])
         if merged is not None:
             merged["rank_counts"] = {
-                str(r): int(s.get("count", 0)) for r, s in pairs}
+                str(r): (int(s.get("count", 0))
+                         if isinstance(s, dict) else 0)
+                for r, s in pairs}
             hists[k] = merged
     return {"ranks": sorted(set(ranks)), "counters": counters,
             "gauges": gauges, "hists": hists}
@@ -283,6 +309,73 @@ def fleet_view(paths, threshold=None):
     """The `monitor fleet` payload: merged counters/gauges/hists over
     every rank artifact plus the straggler report."""
     records = load_records(paths)
+    view = merge_records(records)
+    view["stragglers"] = straggler_report(records,
+                                          threshold=threshold)
+    view["sources"] = [r.get("source") for r in records]
+    return view
+
+
+# -- live scraping (HTTP pull from monitor.server) -------------------------
+
+def _scrape_json(base, path, timeout):
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + path, headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def scrape_records(targets, timeout=5.0, with_flight=True):
+    """Pull live telemetry from running monitor.server instances.
+
+    `targets` are `host:port` strings (scheme optional). Each
+    reachable target contributes the same record shape load_spool()
+    produces from a dump bundle — {rank, stats, hists[, flight_tail],
+    source} — so merge/straggler output is byte-compatible with the
+    bundle-driven path. Unreachable or unparsable targets are
+    collected into `failures` ({target: "ExcType: msg"}) instead of
+    raising: a half-dead fleet still yields a partial report.
+    Returns (records, failures); records are deduped per rank (last
+    target wins) and sorted, mirroring load_records().
+    """
+    records, failures = [], {}
+    for t in targets:
+        base = (t if "//" in t else "http://" + t).rstrip("/")
+        try:
+            snap = _scrape_json(base, "/metrics?format=json", timeout)
+            if not isinstance(snap, dict) or "stats" not in snap:
+                raise ValueError(
+                    "no telemetry snapshot in /metrics?format=json")
+            rec = {"rank": int(snap.get("rank", 0)),
+                   "stats": snap.get("stats") or {},
+                   "hists": snap.get("hists") or {},
+                   "source": base}
+            try:  # status page is decorative; telemetry is the contract
+                rec["status"] = _scrape_json(base, "/statusz", timeout)
+            except Exception:
+                pass
+            if with_flight:
+                try:
+                    fl = _scrape_json(base, "/flightz", timeout)
+                    if isinstance(fl, dict) and fl.get("events"):
+                        rec["flight_tail"] = fl["events"]
+                except Exception:
+                    pass
+            records.append(rec)
+        except Exception as e:  # noqa: BLE001 — per-target isolation
+            failures[t] = f"{type(e).__name__}: {e}"
+            _cmon.stat_add("monitor/fleet/scrape_failures", 1)
+    ranks = {}
+    for rec in records:
+        ranks[rec["rank"]] = rec
+    return [ranks[r] for r in sorted(ranks)], failures
+
+
+def scrape_view(records, threshold=None):
+    """The live twin of fleet_view(): merged counters/gauges/hists
+    plus the straggler report over scrape_records() output."""
     view = merge_records(records)
     view["stragglers"] = straggler_report(records,
                                           threshold=threshold)

@@ -402,6 +402,18 @@ def _numerics_section():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _server_section():
+    """monitor.server.describe() with a total fallback — the dump
+    path (which also runs from the excepthook) must survive a
+    half-imported or torn-down server module."""
+    try:
+        from . import server as _server_mod
+
+        return _server_mod.describe()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def write_dump(reason, extra=None, path=None, full_memory=None):
     """Write one self-contained JSON forensics bundle and return its
     path. Schema (DUMP_SCHEMA = "paddle_tpu.flight/1"):
@@ -453,6 +465,10 @@ def write_dump(reason, extra=None, path=None, full_memory=None):
         # absmax/absmin/nonfinite stats — an overflow in this bundle
         # names the offending tensor, not just the skipped step
         "numerics": _numerics_section(),
+        # live introspection plane (ISSUE 18): whether a debug server
+        # was armed and on which port — a post-mortem can tell
+        # whether /profilez etc. were scrapeable before the crash
+        "server": _server_section(),
     }
     try:
         from . import telemetry_snapshot

@@ -401,7 +401,15 @@ def program_footprints(report=None):
 def memory_report(top_k=None):
     """The full live picture: device stats + per-program footprints +
     the live-array census. What `python -m paddle_tpu.monitor memory`
-    prints and what an OOM bundle embeds."""
+    prints and what an OOM bundle embeds. Degrades to
+    {"uninitialized": True} before any jax backend is live — this is
+    an evidence-gathering path (the /memz handler thread, pre-init
+    REPL hooks) and must never be the thing that initializes a
+    backend."""
+    from . import flight as _flight
+
+    if not _flight._jax_backends_live():
+        return {"uninitialized": True}
     return {"device": memory_stats(),
             "programs": program_footprints(),
             "census": live_array_census(top_k)}

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 from ..core import monitor as _cmon
 from ..core.monitor import snapshot_quantile
+from . import flight as _flight
 from .flight import _env_float, _env_on  # shared env-parsing semantics
 
 __all__ = [
@@ -223,10 +224,15 @@ def device_peaks():
     function, so the two can never disagree on the peak."""
     kind = "cpu"
     try:
-        import jax
+        # evidence-gathering rule (shared with flight's dump path and
+        # the /perfz handler): NEVER initialize a backend just to read
+        # its kind — a debug page touching jax.devices() first could
+        # pick a platform mid-rendezvous. Uninitialized reads as cpu.
+        if _flight._jax_backends_live():
+            import jax
 
-        kind = str(getattr(jax.devices()[0], "device_kind", "")
-                   or jax.devices()[0].platform)
+            kind = str(getattr(jax.devices()[0], "device_kind", "")
+                       or jax.devices()[0].platform)
     except Exception:
         pass
     low = kind.lower()
