@@ -647,13 +647,92 @@ def _itl_ms(gaps):
     return out
 
 
+def _bench_serve_spec(model, prompts, sampling, max_batch, spec_k=4):
+    """ISSUE 19 twin: the SAME mixed-length request set (plus a
+    shared 48-token system prefix, so the prefix cache has full
+    blocks to share) through (a) a plain k=1/no-cache engine and
+    (b) a speculative-decoding + prefix-caching engine. Both are
+    measured at steady state — wave 2 of the same engine, after
+    wave 1 paid the XLA compiles and published the shareable prefix
+    blocks — the regime a long-lived serving replica actually runs
+    in. Reports tokens/s + p50/p99 ITL for both, acceptance rate and
+    prefill-tokens-saved; the emitted tokens are asserted identical
+    to the k=1 baseline, the house discipline."""
+    from paddle_tpu.core import monitor as _cmon
+    from paddle_tpu.inference.serving import LLMEngine
+
+    rng = np.random.RandomState(19)
+    vocab = model.config.vocab_size
+    prefix = list(rng.randint(1, vocab, 48))
+    twin_prompts = [prefix + list(p) for p in prompts]
+
+    def run(**kw):
+        eng = LLMEngine(model, max_batch=max_batch, **kw)
+
+        def wave():
+            ids = [eng.add_request(p, sampling=sampling)
+                   for p in twin_prompts]
+            t0 = time.perf_counter()
+            while eng.has_unfinished():
+                eng.step()
+            dt = time.perf_counter() - t0
+            gaps, outs = [], []
+            for i in ids:
+                req = eng.get_request(i)
+                ts = req.token_times
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+                outs.append(req.output_ids)
+            return outs, gaps, dt
+
+        wave()               # compiles + prefix-block registration
+        outs, gaps, dt = wave()
+        assert not eng.check_drained(), "spec twin leaked KV blocks"
+        return outs, gaps, dt, sum(len(o) for o in outs) / dt
+
+    base_outs, base_gaps, _, base_tps = run()
+    keys = ("serve/spec/proposed", "serve/spec/accepted",
+            "serve/prefix/hits", "serve/prefix/blocks_shared",
+            "serve/prefix/prefill_tokens_saved")
+    before = {k: _cmon.stat_get(k) for k in keys}
+    spec_outs, spec_gaps, spec_dt, spec_tps = run(
+        spec_k=spec_k, prefix_cache=True)
+    assert spec_outs == base_outs, \
+        "speculative/prefix twin diverged from the greedy baseline"
+    d = {k: _cmon.stat_get(k) - before[k] for k in keys}
+    assert spec_tps > base_tps, (
+        f"speculative decoding did not improve steady-state "
+        f"throughput: {spec_tps:.1f} vs {base_tps:.1f} tokens/s")
+    out = {"value": round(spec_tps, 1), "unit": "tokens/s",
+           "window_spread": [round(spec_dt, 6)],
+           "spec_k": spec_k,
+           "baseline_tokens_s": round(base_tps, 1),
+           "speedup_vs_k1": round(spec_tps / base_tps, 3),
+           "accept_rate": round(
+               d["serve/spec/accepted"]
+               / max(1, d["serve/spec/proposed"]), 4),
+           "proposed": d["serve/spec/proposed"],
+           "accepted": d["serve/spec/accepted"],
+           "prefix_hits": d["serve/prefix/hits"],
+           "blocks_shared": d["serve/prefix/blocks_shared"],
+           "prefill_tokens_saved":
+               d["serve/prefix/prefill_tokens_saved"]}
+    out.update(_itl_ms(spec_gaps))
+    base_itl = _itl_ms(base_gaps)
+    out["baseline_itl_p50_ms"] = base_itl["itl_p50_ms"]
+    out["baseline_itl_p99_ms"] = base_itl["itl_p99_ms"]
+    return out
+
+
 def bench_serving(on_tpu):
     """ISSUE 11: the serving engine under mixed-length generation
     traffic — continuous batching (the LLMEngine default) against a
     static-batching twin (admit a batch, drain it, admit the next),
     same requests, same pools. Reports generated tokens/s plus the
     p50/p99 INTER-TOKEN latency the scheduler's interleaving policy
-    actually delivers to a streaming client."""
+    actually delivers to a streaming client. Grows two riders: the
+    ISSUE-13 goodput-under-chaos twin and the ISSUE-19 speculative-
+    decoding + prefix-caching twin (`_bench_serve_spec`, embedded as
+    extra.serve_spec by main())."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import LLMEngine, SamplingParams
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
@@ -701,6 +780,18 @@ def bench_serving(on_tpu):
     r["static_batching_tokens_s"] = round(sb_tps, 1)
     r["cb_vs_static"] = round(cb_tps / sb_tps, 3) if sb_tps else 0.0
 
+    # disarmed-path provenance (ISSUE 19): the baseline runs above
+    # never armed speculation or prefix caching, so they must leave
+    # ZERO serve/spec/* + serve/prefix/* counters behind — the same
+    # zero-overhead contract the sanitize/chaos gates enforce
+    from paddle_tpu.core import monitor as _cmon
+    leaked = {k: v for k, v in _cmon.registry.snapshot().items()
+              if k.startswith(("serve/spec/", "serve/prefix/"))}
+    assert not leaked, (
+        "k=1/no-cache serving runs left spec/prefix counters behind "
+        f"(disarmed paths must be free): {leaked}")
+    r["spec"] = _bench_serve_spec(model, prompts, sampling, max_batch)
+
     # ISSUE-13 goodput-under-chaos twin: the SAME traffic through a
     # 2-replica Router with a serve_decode fault storm armed (OOM
     # churn + one replica kill) and tight queues — tokens/s, p50/p99
@@ -709,7 +800,6 @@ def bench_serving(on_tpu):
     # extra.serve_resilience by main(), so every perf record is
     # provably chaos-annotated (which faults, how many triggers, and
     # what they cost).
-    from paddle_tpu.core import monitor as _cmon
     from paddle_tpu.inference.serving import (EngineOverloaded,
                                               Router)
     from paddle_tpu.monitor import chaos as _chaos
@@ -1071,6 +1161,15 @@ def main(argv=None):
         srv = results.get("serving")
         if isinstance(srv, dict) and "resilience" in srv:
             results["serve_resilience"] = srv.pop("resilience")
+        # speculative-decoding + prefix-cache twin (ISSUE 19): the
+        # serving config's steady-state record with spec_k=4 drafting
+        # + copy-on-write prefix sharing armed — tokens/s and p50/p99
+        # ITL vs the k=1/no-cache baseline on the same request set,
+        # acceptance rate and prefill-tokens-saved. A gateable config
+        # of its own: regress.py picks extra.serve_spec.value up off
+        # the trail automatically
+        if isinstance(srv, dict) and "spec" in srv:
+            results["serve_spec"] = srv.pop("spec")
         # tail-latency trajectories (ISSUE 15): the serving
         # histograms' full bucket summaries + p50/p95/p99 (ms), so
         # BENCH rounds carry latency DISTRIBUTIONS, not just

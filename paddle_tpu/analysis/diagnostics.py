@@ -158,6 +158,15 @@ DIAGNOSTICS = {
                "never read — the failover/drain handoff drops them",
                "re-add every export (import_request), return it to "
                "the caller, or retain it (orphan_exports)"),
+    "PTA074": (Severity.ERROR,
+               "prefix-cache refcount/COW violation: a shared KV "
+               "block written in place (copy-on-write skipped), "
+               "physically reclaimed while another owner still maps "
+               "it, or allocator internals (._free/._refcnt) reached "
+               "from outside the allocator",
+               "check_cow() before every in-place block write; "
+               "release references through share()/release() only "
+               "and keep refcount bookkeeping inside BlockAllocator"),
     "PTA080": (Severity.ERROR,
                "error-feedback residual leaked / never donated: the "
                "quantized allreduce's residual state is dropped or "

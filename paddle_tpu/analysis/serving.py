@@ -26,6 +26,14 @@ This module is the STATIC half (the CLI `--sanitize serving` leg):
     state); snapshots nobody re-adds (`import_request`) are requests
     silently dropped on the failover/drain path — the ISSUE-13
     drop-without-release class, one layer up          (PTA073)
+  * code outside the allocator module reaching through another
+    object into `._free` / `._refcnt` — the allocator's private
+    free-list/refcount structures. With prefix-cached blocks shared
+    copy-on-write between requests, any out-of-band mutation
+    bypasses the refcount discipline (a block returned to the free
+    list while other requests still map it serves garbage KV); the
+    runtime half (`BlockAllocator.check_cow` / `_deref`) catches it
+    as it happens, this is the static gate            (PTA074)
 
 plus `audit_block_accounting(...)`, the programmatic wrapper tests
 and the engine drain path use to turn the runtime allocator state
@@ -41,6 +49,7 @@ from .preflight import _walk_no_nested_defs
 __all__ = ["lint_kv_source", "audit_block_accounting"]
 
 _ALLOC_NAMES = ("alloc", "alloc_blocks")
+_ALLOC_PRIVATE = ("_free", "_refcnt")
 _RELEASE_NAMES = ("release", "free_one", "free", "finish", "evict",
                   "abort")
 _TRACKING_NAMES = ("running", "_running", "requests", "_requests")
@@ -70,13 +79,33 @@ def _is_tracking(node):
 
 def lint_kv_source(source, filename="<string>", report=None):
     """AST pass over one file: discarded alloc results (PTA070),
-    request-drop-without-release paths (PTA072), and exported-but-
-    never-re-added failover snapshots (PTA073)."""
+    request-drop-without-release paths (PTA072), exported-but-
+    never-re-added failover snapshots (PTA073), and out-of-band
+    reaches into the allocator's refcount state (PTA074)."""
     report = report if report is not None else Report()
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError:
         return report
+
+    # PTA074 — only the allocator module itself may touch its private
+    # free-list/refcount structures; `self._free` elsewhere is some
+    # OTHER class's own field, so only non-self reaches are flagged
+    if not filename.endswith("kv_cache.py"):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _ALLOC_PRIVATE and not (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                report.add(
+                    "PTA074",
+                    f"direct access to allocator-private "
+                    f".{node.attr} outside the allocator module — "
+                    "out-of-band mutation bypasses the COW/refcount "
+                    "discipline over shared prefix blocks (use "
+                    "share/release/free_one/refcount)",
+                    file=filename, line=node.lineno,
+                    severity=Severity.ERROR, analyzer="serving")
 
     for node in ast.walk(tree):
         # discarded alloc result — module/class level included

@@ -14,6 +14,16 @@ Decode shape: one query token per sequence.
     block_tables [B, MAXB] int32     pool block id per (seq, slot)
     context_lens [B]       int32     real tokens per sequence
 
+Multi-query decode (`paged_attention_multi`): the speculative-decode
+verify dispatch feeds T consecutive query tokens per sequence — q is
+[B, T, H, D], query slot `t` of sequence `b` sits at absolute
+position `context_lens[b] - 1 + t` and may attend over
+`context_lens[b] + t` tokens (itself included). Same grid, same
+block streaming: the per-slot causal offset is a compile-time
+constant (the T-loop is python-unrolled, T <= 8), so one launch
+verifies a whole draft window per sequence with per-slot position
+masking instead of T separate dispatches.
+
 Grid: (B, MAXB). `block_tables`/`context_lens` ride as SCALAR
 PREFETCH arguments (pltpu.PrefetchScalarGridSpec) so the K/V
 BlockSpec index maps resolve `tables[b, j]` BEFORE the kernel body —
@@ -41,6 +51,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["paged_attention", "paged_attention_reference",
+           "paged_attention_multi", "paged_attention_multi_reference",
            "paged_decode_supported"]
 
 _NEG_INF = -1e30
@@ -167,3 +178,145 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables,
     s = jnp.where(mask[:, None, :], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bshd->bhd", p, seq_v)
+
+
+# ---------------------------------------------------------------------------
+# multi-query decode slots (speculative-decode verification)
+# ---------------------------------------------------------------------------
+
+def _paged_multi_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
+                        o_ref, acc_ref, m_ref, l_ref, *, sm_scale,
+                        block_size, num_slots, num_q):
+    """Per (sequence, table slot) grid step over T query slots. The
+    scratch stacks the T slots' online-softmax state along the
+    sublane axis (rows [t*H, (t+1)*H)); the T-loop is python-unrolled
+    so every per-slot causal offset is a constant."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    ctx0 = lens_ref[b]               # tokens visible to query slot 0
+    h = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # the deepest slot sees ctx0 + num_q - 1 tokens; blocks past that
+    # are dead for EVERY slot and grid-skip like the single-query
+    # kernel. Shallower slots mask the block's tail per-position.
+    @pl.when(j * block_size < ctx0 + num_q - 1)
+    def _step():
+        k = jnp.transpose(k_ref[0], (1, 0, 2))         # [H, BS, D]
+        v = jnp.transpose(v_ref[0], (1, 0, 2))
+        for t in range(num_q):
+            ctx = ctx0 + t
+            q = q_ref[0, t]                            # [H, D]
+            s = jax.lax.dot_general(
+                q[:, None, :], k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)[:, 0, :]
+            s = s * sm_scale                           # [H, BS]
+            k_pos = j * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            # a block entirely past THIS slot's context masks to all
+            # -inf: p underflows to zero and alpha to one, so the
+            # slot's accumulated state passes through untouched
+            s = jnp.where(k_pos < ctx, s, _NEG_INF)
+            m_prev = m_ref[t * h:(t + 1) * h, :1]      # [H, 1]
+            l_prev = l_ref[t * h:(t + 1) * h, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=1,
+                                             keepdims=True)
+            acc_ref[t * h:(t + 1) * h, :] = (
+                acc_ref[t * h:(t + 1) * h, :] * alpha
+                + jax.lax.dot_general(
+                    p.astype(v.dtype)[:, None, :], v,
+                    (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)[:, 0, :])
+            m_ref[t * h:(t + 1) * h, :] = jnp.broadcast_to(
+                m_new, (h, m_ref.shape[1]))
+            l_ref[t * h:(t + 1) * h, :] = jnp.broadcast_to(
+                l_new, (h, l_ref.shape[1]))
+
+    @pl.when(j == num_slots - 1)
+    def _finish():
+        for t in range(num_q):
+            l = jnp.maximum(l_ref[t * h:(t + 1) * h, :1], 1e-30)
+            o_ref[0, t] = (acc_ref[t * h:(t + 1) * h, :]
+                           / l).astype(o_ref.dtype)
+
+
+def paged_attention_multi(q, k_pool, v_pool, block_tables,
+                          context_lens, sm_scale=1.0,
+                          interpret=False):
+    """Multi-query ragged paged-attention: q [B, T, H, D], slot t of
+    sequence b attends `context_lens[b] + t` tokens (per-slot causal
+    masking over the SAME block table). One launch verifies a whole
+    speculative window; T must be small (the slot loop unrolls)."""
+    b, t, h, d = q.shape
+    if t > 8:
+        raise ValueError(
+            f"paged_attention_multi unrolls the slot loop — T={t} "
+            "query slots > 8 would bloat the kernel; use the dense "
+            "reference for long windows")
+    n, bs, hk, dk = k_pool.shape
+    if (hk, dk) != (h, d):
+        raise ValueError(
+            f"pool heads/dim {(hk, dk)} != query {(h, d)}")
+    maxb = block_tables.shape[1]
+    kernel = functools.partial(
+        _paged_multi_kernel, sm_scale=sm_scale, block_size=bs,
+        num_slots=maxb, num_q=t)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, t, h, d),
+                         lambda i, j, bt, cl: (i, 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t, h, d),
+                               lambda i, j, bt, cl: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t * h, d), jnp.float32),
+            pltpu.VMEM((t * h, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((t * h, _STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, t, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(context_lens, jnp.int32), q, k_pool, v_pool)
+
+
+def paged_attention_multi_reference(q, k_pool, v_pool, block_tables,
+                                    context_lens, sm_scale=1.0):
+    """Dense multi-query reference: the verify math the kernel must
+    match, the engine's CPU fallback, AND the prefix-cache tail
+    prefill's attention (slot t at absolute position
+    context_lens[b] - 1 + t sees context_lens[b] + t tokens — the
+    same convention for both uses)."""
+    seq_k = k_pool[block_tables]           # [B, MAXB, BS, H, D]
+    seq_v = v_pool[block_tables]
+    b, maxb, bs, h, d = seq_k.shape
+    t = q.shape[1]
+    seq_k = seq_k.reshape(b, maxb * bs, h, d)
+    seq_v = seq_v.reshape(b, maxb * bs, h, d)
+    s = jnp.einsum("bthd,bshd->bths", q, seq_k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    pos = jnp.arange(maxb * bs)[None, None, :]
+    ctx = context_lens[:, None, None] \
+        + jnp.arange(t)[None, :, None]     # [B, T, 1]
+    mask = pos < ctx                       # [B, T, S]
+    s = jnp.where(mask[:, :, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bths,bshd->bthd", p, seq_v)

@@ -33,6 +33,32 @@ Failure path: a RESOURCE_EXHAUSTED dispatch (real, or injected at
 the `serve_decode` chaos site) evicts the youngest request and
 retries — serving degrades to a smaller batch instead of dying.
 
+Speculative decoding (`spec_k`/`PADDLE_SERVE_SPEC_K` > 1): a small
+DRAFT model — by default the target's first `draft_layers` blocks
+sharing its embeddings and head (`model_runner.draft_params`) —
+proposes k-1 tokens with k cheap batched dispatches against its own
+twin pools, then ONE fixed-shape `verify_step` dispatch runs the
+target over all k slots (pending token + proposals) via the
+multi-query paged-attention kernel. The engine emits the longest
+prefix of proposals that AGREE with the target's own position-seeded
+choices, plus the first disagreeing target token — rejection-free
+greedy verification: every emitted token is the target's own choice
+for its position, so the stream is token-identical to k=1 at ANY
+temperature, and a bad draft only costs speed (1..k tokens per
+verify). `serve/spec/{proposed,accepted}` + `serve/hist/accept_len`
+price the win. Spec paths dispatch through block tables widened by
+one guaranteed-NULL column so near-`max_seq_len` overflow slots
+clamp their garbage writes into the null block.
+
+Prefix caching (`prefix_cache`/`PADDLE_SERVE_PREFIX_CACHE`): full
+immutable prompt blocks are content-hashed after prefill; a later
+request whose prompt chains onto published blocks admits with those
+blocks mapped copy-on-write and prefills ONLY the uncached tail
+(`prefill_tail_step`), with `serve/prefix/{hits,blocks_shared,
+prefill_tokens_saved}` counting the saved work. Both features are
+OFF by default and their disarmed paths leave the k=1 decode/prefill
+programs untouched (the HLO-identity bench contract).
+
 Lifecycle (ISSUE 13 — the failure-policy ring):
 
   * `drain(timeout_s)` — stop admitting (new intake sheds with
@@ -72,9 +98,11 @@ from ...core import monitor as _cmon
 from ...monitor import chaos as _chaos
 from ...monitor import flight as _flight
 from ...monitor import perf as _perf
+from ...monitor import sanitize as _san
 from ...monitor import trace as _trace
 from . import model_runner as _mr
-from .kv_cache import NULL_BLOCK, PagedKVCache, env_max_batch
+from .kv_cache import (NULL_BLOCK, PagedKVCache, env_max_batch,
+                       env_prefix_cache, env_spec_draft, env_spec_k)
 from .scheduler import (EngineOverloaded, EXPORTED, FINISHED,
                         Request, SamplingParams, Scheduler)
 
@@ -99,7 +127,8 @@ class LLMEngine:
     def __init__(self, model, max_batch=None, block_size=None,
                  num_blocks=None, pool_bytes=None, dtype=None,
                  static_batching=False, use_kernel=None,
-                 donate=True, max_queue=None):
+                 donate=True, max_queue=None, spec_k=None,
+                 draft_layers=None, prefix_cache=None):
         import jax
 
         self.params, self.config = _mr.extract_params(model)
@@ -107,10 +136,27 @@ class LLMEngine:
         self.max_batch = int(max_batch or env_max_batch())
         self.max_seq_len = int(cfg.max_seq_len)
         head_dim = cfg.hidden_size // cfg.num_heads
+        # speculative-decode width: 1 = off (the verify kernel
+        # unrolls its query slots, so k is capped at 8)
+        self.spec_k = max(1, min(
+            8, int(spec_k if spec_k is not None else env_spec_k())))
+        if self.spec_k > 1:
+            n_draft = int(draft_layers if draft_layers is not None
+                          else env_spec_draft())
+            if n_draft <= 0:         # auto: half the target's depth
+                n_draft = max(1, cfg.num_layers // 2)
+            self.draft_layers = min(n_draft, cfg.num_layers)
+        else:
+            self.draft_layers = 0
+        self.prefix_cache = bool(
+            prefix_cache if prefix_cache is not None
+            else env_prefix_cache())
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads, head_dim,
             block_size=block_size, num_blocks=num_blocks,
-            pool_bytes=pool_bytes, dtype=dtype)
+            pool_bytes=pool_bytes, dtype=dtype,
+            draft_layers=self.draft_layers,
+            prefix_cache=self.prefix_cache)
         self.block_size = self.cache.block_size
         # fixed table width: enough slots for a max-length sequence
         self.max_blocks_per_seq = math.ceil(
@@ -118,7 +164,8 @@ class LLMEngine:
         self.scheduler = Scheduler(self.cache, self.max_batch,
                                    self.max_seq_len,
                                    static_batching=static_batching,
-                                   max_queue=max_queue)
+                                   max_queue=max_queue,
+                                   spec_tokens=self.spec_k)
         self._requests = {}          # req_id -> Request (all states)
         if use_kernel is None:
             from ...incubate.nn import pallas as _pl
@@ -141,6 +188,32 @@ class LLMEngine:
             decode, donate_argnums=(3, 4) if self._donate else ())
         self._decode_exe = None      # persistent-cache hit, if any
         self._prefill_jits = {}      # padded len -> jitted prefill
+        # -- speculative-decode programs (spec_k > 1 only; the k=1
+        # decode program above stays byte-identical either way)
+        self._draft_params = None
+        self._verify_jit = self._draft_jit = None
+        self._draft_prefill_jits = {}
+        if self.spec_k > 1:
+            self._draft_params = _mr.draft_params(self.params,
+                                                  self.draft_layers)
+            verify = functools.partial(
+                _mr.verify_step, n_head=cfg.num_heads,
+                eps=cfg.layer_norm_eps, block_size=self.block_size,
+                use_kernel=self.use_kernel,
+                interpret=self._kernel_interpret)
+            self._verify_jit = jax.jit(
+                verify,
+                donate_argnums=(3, 4) if self._donate else ())
+            # a separate jit instance for the draft's decode steps:
+            # its donations consume the DRAFT pools, never the
+            # target's
+            self._draft_jit = jax.jit(
+                decode,
+                donate_argnums=(3, 4) if self._donate else ())
+            _cmon.stat_set("serve/spec/k", self.spec_k)
+        # prefix-cache tail-prefill programs (tail length bucketed)
+        self._tail_jits = {}
+        self._draft_tail_jits = {}
         self._pcache_label = (
             f"serve_decode:{type(model).__name__}")
         self._prefill_label = (
@@ -151,6 +224,7 @@ class LLMEngine:
         # the jit shape-specialization naming)
         self._prefill_captured = {}
         self._oom_streak = 0         # consecutive OOM'd dispatches
+        self._spec_warm = False      # first spec round compiles
         # finished requests kept for result retrieval — bounded so a
         # long-lived replica's host memory doesn't grow with total
         # traffic (generate() releases its own as it returns)
@@ -247,7 +321,8 @@ class LLMEngine:
             # never make progress — a pool sized below one request's
             # footprint must be LOUD, not a silent spin
             head = self.scheduler.waiting[0]
-            need = self.cache.blocks_for_tokens(head.context_len) + 1
+            need = self.cache.blocks_for_tokens(head.context_len) \
+                + self.scheduler._lookahead
             if need > self.cache.num_blocks - 1:
                 raise RuntimeError(
                     f"KV pool too small: {head.req_id} needs {need} "
@@ -320,14 +395,35 @@ class LLMEngine:
             self._prefill_jits[padded_len] = jfn
         return jfn
 
+    def _draft_prefill_fn(self, padded_len):
+        import jax
+
+        jfn = self._draft_prefill_jits.get(padded_len)
+        if jfn is None:
+            cfg = self.config
+            fn = functools.partial(
+                _mr.prefill_step, n_head=cfg.num_heads,
+                eps=cfg.layer_norm_eps, block_size=self.block_size)
+            jfn = jax.jit(
+                fn, donate_argnums=(3, 4) if self._donate else ())
+            self._draft_prefill_jits[padded_len] = jfn
+        return jfn
+
     def _prefill(self, req):
         """Causal forward over the (re)admitted request's context —
         prompt plus any generation an eviction preserved — writing
-        its K/V and sampling the next token."""
+        its K/V and sampling the next token. With prefix caching on
+        and a cache hit at admission, only the uncached TAIL runs
+        (`_prefill_tail`); either way the request's full immutable
+        blocks are published for later sharers, and with speculation
+        armed the draft model prefills its twin pools over the same
+        table."""
         import jax.numpy as jnp
 
         ctx = req.prompt_ids + req.output_ids
         plen = len(ctx)
+        if self.prefix_cache and req.cached_tokens:
+            return self._prefill_tail(req, ctx, plen)
         padded = self.cache.blocks_for_tokens(plen) * self.block_size
         ids = np.zeros((1, padded), np.int32)
         ids[0, :plen] = ctx
@@ -347,6 +443,14 @@ class LLMEngine:
                 np.float32(s.temperature), np.int32(s.top_k),
                 np.uint32(_mr.seed_for(s.seed, plen)))
             tok = int(tok)
+            if self._draft_params is not None:
+                _, self.cache.k_draft, self.cache.v_draft = \
+                    self._draft_prefill_fn(padded)(
+                        self._draft_params, jnp.asarray(ids),
+                        np.int32(plen), self.cache.k_draft,
+                        self.cache.v_draft, jnp.asarray(table),
+                        np.float32(0.0), np.int32(0), np.uint32(0))
+                req._spec_gap = False
         dur_us = int((time.perf_counter() - t0) * 1e6)
         _cmon.stat_add("serve/prefill_us", dur_us)
         if not fresh_bucket and _perf.dispatch_timing_enabled():
@@ -361,6 +465,76 @@ class LLMEngine:
             # drain replay leg (the preserved output_ids re-prefill)
             _trace.note(req, "prefill", tokens=plen, dur_us=dur_us,
                         replayed=len(req.output_ids))
+        self.cache.register_prefix(req.req_id, ctx)
+        self.heartbeat = time.monotonic()
+        return tok
+
+    def _tail_fn(self, t_pad, draft):
+        import jax
+
+        jits = self._draft_tail_jits if draft else self._tail_jits
+        jfn = jits.get(t_pad)
+        if jfn is None:
+            cfg = self.config
+            fn = functools.partial(
+                _mr.prefill_tail_step, n_head=cfg.num_heads,
+                eps=cfg.layer_norm_eps, block_size=self.block_size)
+            jfn = jax.jit(
+                fn, donate_argnums=(4, 5) if self._donate else ())
+            jits[t_pad] = jfn
+        return jfn
+
+    def _prefill_tail(self, req, ctx, plen):
+        """Prefix-cache hit: the leading `req.cached_tokens` (a block
+        multiple, capped below plen) already sit in shared blocks —
+        compile/dispatch over the TAIL only. The tail writes land
+        exclusively in the request's private blocks (admission caps
+        sharing below the full context, so the tail is never empty);
+        with the serving sanitizer armed, `check_cow` proves it."""
+        import jax.numpy as jnp
+
+        cached = req.cached_tokens
+        tail = ctx[cached:]
+        t_pad = (self.cache.blocks_for_tokens(plen) * self.block_size
+                 - cached)
+        ids = np.zeros((1, t_pad), np.int32)
+        ids[0, :len(tail)] = tail
+        table = self.cache.block_table(req.req_id,
+                                       self.max_blocks_per_seq)
+        if getattr(_san, "_serving", False):
+            private = self.cache.allocator.owned(
+                req.req_id)[cached // self.block_size:]
+            for bid in private:
+                self.cache.allocator.check_cow(bid)
+        s = req.sampling
+        t0 = time.perf_counter()
+        with _flight.in_flight("serve_prefill", req.req_id,
+                               tokens=len(tail), cached=cached):
+            tok, self.cache.k, self.cache.v = \
+                self._tail_fn(t_pad, draft=False)(
+                    self.params, jnp.asarray(ids), np.int32(cached),
+                    np.int32(plen), self.cache.k, self.cache.v,
+                    jnp.asarray(table), np.float32(s.temperature),
+                    np.int32(s.top_k),
+                    np.uint32(_mr.seed_for(s.seed, plen)))
+            tok = int(tok)
+            if self._draft_params is not None:
+                _, self.cache.k_draft, self.cache.v_draft = \
+                    self._tail_fn(t_pad, draft=True)(
+                        self._draft_params, jnp.asarray(ids),
+                        np.int32(cached), np.int32(plen),
+                        self.cache.k_draft, self.cache.v_draft,
+                        jnp.asarray(table), np.float32(0.0),
+                        np.int32(0), np.uint32(0))
+                req._spec_gap = False
+        dur_us = int((time.perf_counter() - t0) * 1e6)
+        _cmon.stat_add("serve/prefill_us", dur_us)
+        _cmon.stat_add("serve/prefix/prefill_tokens_saved", cached)
+        if _trace._armed:
+            _trace.note(req, "prefill", tokens=len(tail),
+                        cached=cached, dur_us=dur_us,
+                        replayed=len(req.output_ids))
+        self.cache.register_prefix(req.req_id, ctx)
         self.heartbeat = time.monotonic()
         return tok
 
@@ -485,8 +659,12 @@ class LLMEngine:
         RESOURCE_EXHAUSTED mid-execution deletes donated buffers —
         retrying with them is the PTA041 use-after-donate crash.)"""
         try:
-            return bool(self.cache.k.is_deleted()
+            dead = bool(self.cache.k.is_deleted()
                         or self.cache.v.is_deleted())
+            if not dead and self.cache.k_draft is not None:
+                dead = bool(self.cache.k_draft.is_deleted()
+                            or self.cache.v_draft.is_deleted())
+            return dead
         except Exception:
             return False
 
@@ -499,12 +677,14 @@ class LLMEngine:
         token-exact). A persistent OOM re-raises after
         max(3, max_batch) consecutive failed dispatches instead of
         spinning on evict/readmit forever."""
+        if self.spec_k > 1:
+            return self._spec_decode_batch(emitted)
         # snapshot the batch, but re-check membership per request:
         # growing request A can evict request B later in the
         # snapshot, and growing an evicted B would strand blocks on
         # a request the dispatch no longer covers
         for req in list(self.scheduler.running.values()):
-            self.scheduler.ensure_capacity(req)
+            self.scheduler.ensure_capacity(req, new_tokens=1)
         if not self.scheduler.running:
             return
         arrays = self._batch_arrays()
@@ -551,6 +731,223 @@ class LLMEngine:
             _perf.observe_dispatch(self._pcache_label, decode_us)
         for slot, req in list(self.scheduler.running.items()):
             self._emit(req, int(toks[slot]), emitted)
+
+    # -- speculative decode (spec_k > 1) -----------------------------
+    def _wide_tables(self, tables):
+        """Spec dispatch tables carry ONE extra guaranteed-NULL
+        column: a near-`max_seq_len` slot whose position overflows
+        the real table width clamps into the null block (XLA gather
+        clamps out-of-range indices) instead of corrupting an at-cap
+        sequence's own last block."""
+        wide = np.full(
+            (tables.shape[0], self.max_blocks_per_seq + 1),
+            NULL_BLOCK, np.int32)
+        wide[:, :-1] = tables
+        return wide
+
+    def _check_spec_cow(self, running):
+        """PTA074 runtime half (armed only): every block a spec round
+        writes through — the realign/pending position onward — must
+        be exclusively owned. Shared prefix blocks all precede the
+        write frontier, so a trip here is a refcount/COW bug, not
+        load."""
+        if not getattr(_san, "_serving", False):
+            return
+        for req in running.values():
+            lo = req.context_len - (2 if req._spec_gap else 1)
+            for bid in self.cache.allocator.owned(
+                    req.req_id)[lo // self.block_size:]:
+                self.cache.allocator.check_cow(bid)
+
+    def _draft_propose(self, running, wide_j):
+        """k batched draft-model decode dispatches -> k-1 proposed
+        tokens per running request.
+
+        Step 0 is the REALIGN step: a request whose previous round
+        accepted every proposal has one context position whose draft
+        KV was never written (the verify step only writes TARGET KV).
+        Re-feeding ctx[-2] at its own position rewrites that slot
+        idempotently; requests without the gap re-feed ctx[-1]
+        (duplicating step 1's write — same value, discarded output),
+        keeping the dispatch fixed-shape. Steps 1..k-1 feed the
+        pending token then each proposal onward, every write landing
+        in the request's private tail — position-keyed seeds make
+        the proposals deterministic across replays."""
+        import jax.numpy as jnp
+
+        b = self.max_batch
+        r_ids = np.zeros((b,), np.int32)
+        r_pos = np.zeros((b,), np.int32)
+        r_lens = np.ones((b,), np.int32)
+        zeros_f = np.zeros((b,), np.float32)
+        zeros_i = np.zeros((b,), np.int32)
+        zeros_u = np.zeros((b,), np.uint32)
+        for slot, req in running.items():
+            ctx = req.prompt_ids + req.output_ids
+            back = 2 if req._spec_gap else 1
+            r_ids[slot] = ctx[-back]
+            r_pos[slot] = len(ctx) - back
+            r_lens[slot] = len(ctx) - back + 1
+        _, self.cache.k_draft, self.cache.v_draft = self._draft_jit(
+            self._draft_params, jnp.asarray(r_ids),
+            jnp.asarray(r_pos), self.cache.k_draft,
+            self.cache.v_draft, wide_j, jnp.asarray(r_lens),
+            jnp.asarray(zeros_f), jnp.asarray(zeros_i),
+            jnp.asarray(zeros_u))
+        drafts = {slot: [] for slot in running}
+        ids = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        lens = np.ones((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        topk = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
+        for slot, req in running.items():
+            ctx = req.prompt_ids + req.output_ids
+            ids[slot] = ctx[-1]
+            pos[slot] = len(ctx) - 1
+            lens[slot] = len(ctx)
+            s = req.sampling
+            temp[slot] = s.temperature
+            topk[slot] = s.top_k
+            seeds[slot] = _mr.seed_for(s.seed, len(ctx))
+        for _ in range(self.spec_k - 1):
+            toks, self.cache.k_draft, self.cache.v_draft = \
+                self._draft_jit(
+                    self._draft_params, jnp.asarray(ids),
+                    jnp.asarray(pos), self.cache.k_draft,
+                    self.cache.v_draft, wide_j, jnp.asarray(lens),
+                    jnp.asarray(temp), jnp.asarray(topk),
+                    jnp.asarray(seeds))
+            toks = np.asarray(toks)
+            for slot, req in running.items():
+                d = int(toks[slot])
+                drafts[slot].append(d)
+                ids[slot] = d
+                pos[slot] += 1
+                lens[slot] += 1
+                seeds[slot] = _mr.seed_for(req.sampling.seed,
+                                           int(lens[slot]))
+        return drafts
+
+    def _dispatch_verify(self, running, drafts, wide_j, arrays):
+        """ONE fixed-shape target dispatch over all k slots: slot 0
+        the pending token, slots 1.. the draft proposals. Returns
+        [B, k] target choices, each sampled with the SAME
+        position-keyed seed the k=1 engine would use."""
+        import jax.numpy as jnp
+
+        _, pos, _, lens, temp, topk, _ = arrays
+        b = self.max_batch
+        k = self.spec_k
+        v_ids = np.zeros((b, k), np.int32)
+        v_seeds = np.zeros((b, k), np.uint32)
+        for slot, req in running.items():
+            ctx = req.prompt_ids + req.output_ids
+            v_ids[slot, 0] = ctx[-1]
+            for t, d in enumerate(drafts[slot]):
+                v_ids[slot, t + 1] = d
+            for t in range(k):
+                v_seeds[slot, t] = _mr.seed_for(req.sampling.seed,
+                                                len(ctx) + t)
+        toks, self.cache.k, self.cache.v = self._verify_jit(
+            self.params, jnp.asarray(v_ids), jnp.asarray(pos),
+            self.cache.k, self.cache.v, wide_j, jnp.asarray(lens),
+            jnp.asarray(temp), jnp.asarray(topk),
+            jnp.asarray(v_seeds))
+        return np.asarray(toks)
+
+    def _spec_decode_batch(self, emitted):
+        """One speculative round: k draft dispatches propose, one
+        verify dispatch checks all proposals, the engine emits the
+        longest agreeing prefix plus the first corrected token —
+        1..k tokens per round, all of them the target's own
+        position-seeded choices (token-identical to k=1). OOM
+        handling mirrors `_decode_batch`: evict-and-retry, or
+        rebuild-and-replay when a donating dispatch consumed the
+        pools."""
+        import jax.numpy as jnp
+
+        k = self.spec_k
+        for req in list(self.scheduler.running.values()):
+            # k-aware growth, capped so an almost-finished sequence
+            # never asks for blocks past max_seq_len's table width
+            self.scheduler.ensure_capacity(req, new_tokens=min(
+                k, max(1, self.max_seq_len - req.context_len)))
+        if not self.scheduler.running:
+            return
+        arrays = self._batch_arrays()
+        wide_j = jnp.asarray(self._wide_tables(arrays[2]))
+        running = dict(self.scheduler.running)
+        self._check_spec_cow(running)
+        fresh_decode = self._verify_jit is not None \
+            and not getattr(self, "_spec_warm", False)
+        t0 = time.perf_counter()
+        try:
+            with _flight.in_flight("serve_decode", "spec_decode",
+                                   batch=len(running), k=k):
+                if _chaos._armed:
+                    _chaos.hit("serve_decode", batch=len(running))
+                drafts = self._draft_propose(running, wide_j)
+                if _chaos._armed:
+                    rule = _chaos.hit("serve_spec_verify",
+                                      batch=len(running), k=k)
+                    if rule is not None:
+                        # forced draft divergence: verification must
+                        # reject every corrupted proposal and still
+                        # emit the target's own token — degrading to
+                        # >= 1 token/round, never to wrong tokens
+                        vocab = self.config.vocab_size
+                        drafts = {
+                            slot: [(d + 1) % vocab for d in ds]
+                            for slot, ds in drafts.items()}
+                toks = self._dispatch_verify(running, drafts,
+                                             wide_j, arrays)
+        except Exception as e:
+            from ...monitor import memory as _memory
+
+            if not _memory.is_oom_error(e):
+                raise
+            self._oom_streak += 1
+            if self._oom_streak > max(3, self.max_batch):
+                raise
+            _cmon.stat_add("serve/oom_evictions", 1)
+            if self._pools_deleted():
+                _cmon.stat_add("serve/pool_resets", 1)
+                _flight.record("serve_pool_reset",
+                               batch=len(self.scheduler.running))
+                for req in list(self.scheduler.running.values()):
+                    self.scheduler.evict(req)
+                self.cache.reset_pools()
+                return                # next step() re-prefills
+            victim = self.scheduler._pick_victim()
+            if victim is None:
+                raise
+            self.scheduler.evict(victim)
+            return self._spec_decode_batch(emitted)
+        self._oom_streak = 0
+        self._spec_warm = True
+        self.heartbeat = time.monotonic()
+        decode_us = int((time.perf_counter() - t0) * 1e6)
+        _cmon.stat_add("serve/decode_us", decode_us)
+        if not fresh_decode and _perf.dispatch_timing_enabled():
+            _perf.observe_dispatch(self._pcache_label, decode_us)
+        for slot, req in sorted(running.items()):
+            ds = drafts[slot]
+            row = toks[slot]
+            m = 0
+            while m < len(ds) and ds[m] == int(row[m]):
+                m += 1
+            _cmon.stat_add("serve/spec/proposed", len(ds))
+            _cmon.stat_add("serve/spec/accepted", m)
+            _cmon.hist_observe("serve/hist/accept_len", m + 1)
+            # all proposals accepted -> one draft-KV position was
+            # never written (verify writes only TARGET KV); the next
+            # round's realign step fills it
+            req._spec_gap = (m == len(ds))
+            for t in range(m + 1):
+                self._emit(req, int(row[t]), emitted)
+                if req.finished:
+                    break
 
     # -- token emission / stop conditions ----------------------------
     def _emit(self, req, token, emitted):
@@ -610,8 +1007,9 @@ class LLMEngine:
         hasn't admitted yet. list() snapshots the deque atomically
         (C-level copy) so a concurrent admission pass can't raise
         mutated-during-iteration under the router's read."""
+        lookahead = self.scheduler._lookahead
         pending = sum(
-            self.cache.blocks_for_tokens(r.context_len) + 1
+            self.cache.blocks_for_tokens(r.context_len) + lookahead
             for r in list(self.scheduler.waiting))
         return self.cache.allocator.free_blocks - pending
 
@@ -630,6 +1028,8 @@ class LLMEngine:
             "used_blocks": self.cache.allocator.used_blocks,
             "oom_streak": self._oom_streak,
             "heartbeat_age_s": round(self.heartbeat_age(), 3),
+            "spec_k": self.spec_k,
+            "prefix_cache": self.prefix_cache,
         }
 
     def _export(self, req):

@@ -29,14 +29,31 @@ gauges (PR-1 monitor hub) track occupancy. Pool sizing comes from
 PR-5 `monitor.memory.memory_stats()` free-HBM reading, discounted by
 the per-program footprints already resident.
 
+Prefix caching (copy-on-write sharing): every block carries a
+REFCOUNT, and FULL immutable blocks are published in a content-hash
+index keyed by a chain hash (each block's digest folds in its
+predecessor's, so a hit at depth i proves the whole prefix matches
+AND that repeated identical chunks inside one prompt never collide).
+`PagedKVCache.admit()` maps a new request's cached prefix blocks
+into its table by bumping refcounts — no data movement — and
+allocates only the uncached remainder; prefill then runs only the
+tail. Shared blocks are immutable: the engine's write positions are
+always >= the cached prefix, and `check_cow()` enforces it. A block
+returns to the free list only when its LAST reference drops, which
+also deregisters its hash (so eviction of one sharer never reclaims
+— or republishes stale — shared content).
+
 PTA07x (block-leak) accounting: with `PADDLE_SANITIZE=serving` armed,
 double-free / free-of-unowned trips a PTA071 finding at the faulting
-call, and `audit_leaks(live_owners)` reports PTA070 for blocks still
-owned by requests the serving layer no longer tracks. The static half
-lives in `paddle_tpu.analysis.serving`.
+call, `audit_leaks(live_owners)` reports PTA070 for blocks still
+owned by requests the serving layer no longer tracks, and PTA074
+flags copy-on-write violations (a shared block written through, or a
+block physically reclaimed while another table still maps it). The
+static half lives in `paddle_tpu.analysis.serving`.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 import os
 from collections import deque
@@ -48,7 +65,8 @@ from ...monitor import sanitize as _san
 
 __all__ = ["BlockAllocator", "PagedKVCache", "NULL_BLOCK",
            "env_block_size", "env_pool_bytes", "env_max_batch",
-           "auto_num_blocks", "bytes_per_block"]
+           "env_spec_k", "env_spec_draft", "env_prefix_cache",
+           "auto_num_blocks", "bytes_per_block", "prefix_hashes"]
 
 NULL_BLOCK = 0  # reserved garbage-dump block, never owned
 
@@ -80,6 +98,43 @@ def env_pool_bytes():
 def env_max_batch():
     """PADDLE_SERVE_MAX_BATCH — decode batch width (default 8)."""
     return max(1, _env_int("PADDLE_SERVE_MAX_BATCH", _DEF_MAX_BATCH))
+
+
+def env_spec_k():
+    """PADDLE_SERVE_SPEC_K — speculative tokens per dispatch
+    (default 1 = speculation off, plain one-token decode)."""
+    return max(1, min(8, _env_int("PADDLE_SERVE_SPEC_K", 1)))
+
+
+def env_spec_draft():
+    """PADDLE_SERVE_SPEC_DRAFT — draft model layer count (default
+    0 = auto: half the target's layers, minimum 1)."""
+    return max(0, _env_int("PADDLE_SERVE_SPEC_DRAFT", 0))
+
+
+def env_prefix_cache():
+    """PADDLE_SERVE_PREFIX_CACHE — 1 enables copy-on-write prefix
+    block sharing (default 0 = off)."""
+    return 1 if _env_int("PADDLE_SERVE_PREFIX_CACHE", 0) else 0
+
+
+def prefix_hashes(tokens, block_size, n_blocks=None):
+    """Chain hashes for the leading FULL blocks of a token sequence:
+    digest(i) = sha256(digest(i-1) || tokens of block i). The chain
+    makes a depth-i hit prove the entire prefix matches and keeps
+    repeated identical chunks within one prompt distinct."""
+    if n_blocks is None:
+        n_blocks = len(tokens) // block_size
+    out = []
+    h = b"\x00" * 32
+    for i in range(n_blocks):
+        m = hashlib.sha256()
+        m.update(h)
+        m.update(np.asarray(tokens[i * block_size:(i + 1) * block_size],
+                            np.int64).tobytes())
+        h = m.digest()
+        out.append(h)
+    return out
 
 
 def bytes_per_block(num_layers, block_size, n_head, head_dim, dtype):
@@ -115,9 +170,16 @@ class BlockAllocator:
     """Host-side free-list over the pool's block ids.
 
     Block 0 (NULL_BLOCK) is never handed out. Ownership is tracked
-    per request id so leaks are attributable: `release(owner)` frees
-    everything an owner holds, `audit_leaks(live)` reports blocks
-    owned by ids the caller no longer tracks (PTA070)."""
+    per request id so leaks are attributable: `release(owner)` drops
+    every reference an owner holds, `audit_leaks(live)` reports
+    blocks owned by ids the caller no longer tracks (PTA070).
+
+    Refcounts: a freshly allocated block has refcount 1; `share()`
+    maps it into another owner's table copy-on-write (refcount up,
+    no data movement). A block is physically reclaimed — returned to
+    the free list and dropped from the content-hash index — only
+    when its LAST reference goes, so evicting one sharer can never
+    free (or stale-publish) blocks another request still reads."""
 
     def __init__(self, num_blocks):
         if num_blocks < 2:
@@ -127,6 +189,9 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self._free = deque(range(1, self.num_blocks))
         self._owned = {}  # owner id -> [block ids]
+        self._refcnt = {}  # block id -> live references
+        self._by_hash = {}  # chain digest -> block id
+        self._hash_of = {}  # block id -> chain digest
         self._sync_gauges()
 
     # -- occupancy ---------------------------------------------------
@@ -147,6 +212,9 @@ class BlockAllocator:
     def can_alloc(self, n):
         return len(self._free) >= n
 
+    def refcount(self, block_id):
+        return self._refcnt.get(block_id, 0)
+
     def _sync_gauges(self):
         _cmon.stat_set("serve/kv_blocks/used", self.used_blocks)
         _cmon.stat_set("serve/kv_blocks/free", self.free_blocks)
@@ -161,18 +229,102 @@ class BlockAllocator:
         if len(self._free) < n:
             return None
         got = [self._free.popleft() for _ in range(n)]
+        for b in got:
+            self._refcnt[b] = 1
         self._owned.setdefault(owner, []).extend(got)
         self._sync_gauges()
         return got
 
+    def share(self, owner, block_id):
+        """Map a LIVE block into `owner`'s table copy-on-write: the
+        refcount goes up and nothing moves. The callers' contract is
+        that shared blocks are full and immutable — `check_cow`
+        enforces it on write paths."""
+        if block_id == NULL_BLOCK or block_id not in self._refcnt:
+            raise ValueError(
+                f"cannot share unallocated block {block_id}")
+        self._refcnt[block_id] += 1
+        self._owned.setdefault(owner, []).append(block_id)
+        self._sync_gauges()
+        return block_id
+
+    def _deref(self, block_id):
+        """Drop one reference; physically reclaim on the last one.
+        Returns 1 when the block actually hit the free list."""
+        rc = self._refcnt.get(block_id, 1) - 1
+        if rc > 0:
+            self._refcnt[block_id] = rc
+            return 0
+        self._refcnt.pop(block_id, None)
+        digest = self._hash_of.pop(block_id, None)
+        if digest is not None and self._by_hash.get(digest) == block_id:
+            del self._by_hash[digest]
+        if getattr(_san, "_serving", False):
+            # defensive PTA074 half: reclaiming a block some OTHER
+            # table still maps means a refcount was lost somewhere
+            holders = [o for o, bl in self._owned.items()
+                       if block_id in bl]
+            if holders:
+                _san._emit(
+                    "PTA074",
+                    f"block {block_id} physically reclaimed while "
+                    f"still mapped by {holders!r} (refcount lost)",
+                    dedup=("PTA074", "reclaim", block_id))
+        self._free.append(block_id)
+        return 1
+
+    def check_cow(self, block_id):
+        """Copy-on-write guard: a block mapped by more than one
+        request is immutable — writing through it would corrupt a
+        stranger's context. PTA074 when the serving sanitizer is
+        armed, ValueError always."""
+        rc = self._refcnt.get(block_id, 1)
+        if rc > 1:
+            if getattr(_san, "_serving", False):
+                _san._emit(
+                    "PTA074",
+                    f"write to shared block {block_id} (refcount "
+                    f"{rc}) without copy-on-write",
+                    dedup=("PTA074", "cow", block_id))
+            raise ValueError(
+                f"block {block_id} is shared by {rc} requests and "
+                f"immutable (copy-on-write required)")
+        return block_id
+
+    # -- content-hash index (prefix cache) ---------------------------
+    def register_hash(self, block_id, digest):
+        """Publish one full immutable block under its chain digest.
+        Lookup-first: an already-published digest (or an already-
+        published block) keeps its existing mapping. Returns 1 on a
+        new registration, 0 on skip."""
+        if digest in self._by_hash or block_id in self._hash_of:
+            return 0
+        if block_id == NULL_BLOCK or block_id not in self._refcnt:
+            raise ValueError(
+                f"cannot index unallocated block {block_id}")
+        self._by_hash[digest] = block_id
+        self._hash_of[block_id] = digest
+        return 1
+
+    def lookup_hash(self, digest):
+        return self._by_hash.get(digest)
+
+    def clear_hash_index(self):
+        """Forget every published block — pool resets zero the K/V
+        contents, so pre-reset digests would serve garbage."""
+        self._by_hash.clear()
+        self._hash_of.clear()
+
     def release(self, owner):
-        """Free every block `owner` holds; returns how many. Unknown
-        owners are a no-op (a request evicted before its first alloc
-        has nothing to free)."""
+        """Drop every reference `owner` holds; returns how many
+        references were dropped (shared blocks stay resident for
+        their other owners). Unknown owners are a no-op (a request
+        evicted before its first alloc has nothing to free)."""
         blocks = self._owned.pop(owner, None)
         if not blocks:
             return 0
-        self._free.extend(blocks)
+        for b in blocks:
+            self._deref(b)
         self._sync_gauges()
         return len(blocks)
 
@@ -193,7 +345,7 @@ class BlockAllocator:
         blocks.remove(block_id)
         if not blocks:
             self._owned.pop(owner, None)
-        self._free.append(block_id)
+        self._deref(block_id)
         self._sync_gauges()
         return block_id
 
@@ -223,7 +375,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim,
                  block_size=None, num_blocks=None, pool_bytes=None,
-                 dtype=None):
+                 dtype=None, draft_layers=0, prefix_cache=False):
         import jax.numpy as jnp
 
         self.block_size = int(block_size or env_block_size())
@@ -231,6 +383,8 @@ class PagedKVCache:
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype or jnp.float32)
+        self.draft_layers = int(draft_layers)
+        self.prefix_cache = bool(prefix_cache)
         per_block = bytes_per_block(num_layers, self.block_size,
                                     num_heads, head_dim, self.dtype)
         if num_blocks is None:
@@ -241,18 +395,88 @@ class PagedKVCache:
                  self.num_heads, self.head_dim)
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
+        # draft-model twin pools address through the SAME allocator
+        # and tables — the chain-hash identity that lets two requests
+        # share target KV holds for draft KV too, so one refcount
+        # covers both
+        self.k_draft = self.v_draft = None
+        if self.draft_layers:
+            dshape = (self.draft_layers,) + shape[1:]
+            self.k_draft = jnp.zeros(dshape, self.dtype)
+            self.v_draft = jnp.zeros(dshape, self.dtype)
         self.allocator = BlockAllocator(self.num_blocks)
 
     # -- geometry ----------------------------------------------------
     def blocks_for_tokens(self, n_tokens):
         return max(1, math.ceil(n_tokens / self.block_size))
 
-    def can_admit(self, n_tokens, lookahead_blocks=1):
-        """Admission control: room for the prompt's blocks plus a
-        decode lookahead so a request admitted now can generate at
-        least one block of tokens before pool pressure."""
-        need = self.blocks_for_tokens(n_tokens) + lookahead_blocks
+    def can_admit(self, n_tokens, lookahead_blocks=1,
+                  cached_blocks=0):
+        """Admission control: room for the prompt's blocks (less any
+        already cached) plus a decode lookahead so a request admitted
+        now can generate at least one block of tokens before pool
+        pressure. Speculative decoding passes a k-aware lookahead —
+        a verify dispatch can land up to k tokens at once."""
+        need = max(0, self.blocks_for_tokens(n_tokens)
+                   - cached_blocks) + lookahead_blocks
         return self.allocator.can_alloc(need)
+
+    # -- prefix cache ------------------------------------------------
+    def probe_prefix(self, tokens):
+        """(cached_blocks, block_ids): the longest chain of leading
+        FULL blocks already published, capped BELOW the full context
+        so the tail prefill always has >= 1 real token to run (and a
+        row to sample from)."""
+        if not self.prefix_cache or not len(tokens):
+            return 0, []
+        cap = max(0, (len(tokens) - 1) // self.block_size)
+        ids = []
+        for digest in prefix_hashes(tokens, self.block_size, cap):
+            b = self.allocator.lookup_hash(digest)
+            if b is None:
+                break
+            ids.append(b)
+        return len(ids), ids
+
+    def admit(self, owner, tokens):
+        """Atomically give `owner` the blocks for its context: cached
+        prefix blocks map copy-on-write (shared ids lead the table,
+        matching their token positions), only the remainder comes off
+        the free list. Returns the cached TOKEN count (0 when the
+        cache is off or cold), or None when the pool can't cover the
+        uncached remainder — never a partial grant."""
+        total = self.blocks_for_tokens(len(tokens))
+        n_shared, shared = self.probe_prefix(tokens)
+        fresh = total - n_shared
+        if not self.allocator.can_alloc(fresh):
+            return None
+        for b in shared:
+            self.allocator.share(owner, b)
+        if fresh and self.allocator.alloc(owner, fresh) is None:
+            for b in shared:  # can't happen single-threaded; unwind
+                self.allocator.free_one(owner, b)
+            return None
+        if n_shared:
+            _cmon.stat_add("serve/prefix/hits", 1)
+            _cmon.stat_add("serve/prefix/blocks_shared", n_shared)
+        return n_shared * self.block_size
+
+    def register_prefix(self, owner, tokens):
+        """Publish `owner`'s full prompt blocks (written, immutable
+        from here on) in the content index so later admissions can
+        share them. Lookup-first — blocks already published, and
+        digests already claimed, keep their existing mapping. Decode
+        extends context into NEW blocks only, so published content
+        never mutates. Returns how many blocks were newly published."""
+        if not self.prefix_cache:
+            return 0
+        blocks = self.allocator.owned(owner)
+        full = min(len(tokens) // self.block_size, len(blocks))
+        n = 0
+        for i, digest in enumerate(
+                prefix_hashes(tokens, self.block_size, full)):
+            n += self.allocator.register_hash(blocks[i], digest)
+        return n
 
     def block_table(self, owner, max_blocks):
         """Padded int32 device-table row for one request: its owned
@@ -278,6 +502,13 @@ class PagedKVCache:
                  self.num_heads, self.head_dim)
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
+        if self.draft_layers:
+            dshape = (self.draft_layers,) + shape[1:]
+            self.k_draft = jnp.zeros(dshape, self.dtype)
+            self.v_draft = jnp.zeros(dshape, self.dtype)
+        # zeroed pools invalidate every published prefix — serving a
+        # pre-reset digest would share garbage KV
+        self.allocator.clear_hash_index()
 
     # -- defrag ------------------------------------------------------
     def defrag(self):
@@ -291,8 +522,9 @@ class PagedKVCache:
         nxt = 1
         for owner in owners:
             for b in self.allocator._owned[owner]:
-                mapping[b] = nxt
-                nxt += 1
+                if b not in mapping:  # shared blocks move ONCE
+                    mapping[b] = nxt
+                    nxt += 1
         moved = sum(1 for old, new in mapping.items() if old != new)
         if not moved:
             return 0
@@ -307,9 +539,21 @@ class PagedKVCache:
         idx = jnp.asarray(perm)
         self.k = self.k[:, idx]
         self.v = self.v[:, idx]
+        if self.k_draft is not None:
+            self.k_draft = self.k_draft[:, idx]
+            self.v_draft = self.v_draft[:, idx]
         for owner in owners:
             self.allocator._owned[owner] = [
                 mapping[b] for b in self.allocator._owned[owner]]
+        self.allocator._refcnt = {
+            mapping[b]: c
+            for b, c in self.allocator._refcnt.items()}
+        self.allocator._by_hash = {
+            h: mapping[b]
+            for h, b in self.allocator._by_hash.items()}
+        self.allocator._hash_of = {
+            mapping[b]: h
+            for b, h in self.allocator._hash_of.items()}
         self.allocator._free = deque(
             range(nxt, self.num_blocks))
         self.allocator._sync_gauges()

@@ -41,6 +41,7 @@ from ...text.models.gpt import (_attention, _layer_norm,
                                 _residual_layer_norm)
 
 __all__ = ["extract_params", "prefill_step", "decode_step",
+           "verify_step", "prefill_tail_step", "draft_params",
            "sample_tokens", "seed_for"]
 
 
@@ -52,6 +53,26 @@ def extract_params(model):
         lambda p: p._value if hasattr(p, "_value") else jnp.asarray(p),
         tree)
     return params, gpt.config
+
+
+def draft_params(params, n_layers):
+    """Truncated-layer twin of the target for speculative drafting:
+    the first `n_layers` transformer blocks with the embedding /
+    final-norm / lm-head weights shared as-is. The draft only has to
+    AGREE with the target often enough to pay for its dispatches —
+    verification makes the emitted stream the target's own tokens
+    regardless of draft quality."""
+    if n_layers < 1:
+        raise ValueError(f"draft needs >= 1 layer, got {n_layers}")
+    total = jax.tree_util.tree_leaves(
+        params["blocks"])[0].shape[0]
+    if n_layers > total:
+        raise ValueError(
+            f"draft layers {n_layers} > target layers {total}")
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(
+        lambda a: a[:n_layers], params["blocks"])
+    return out
 
 
 def seed_for(request_seed, token_index):
@@ -203,3 +224,146 @@ def decode_step(params, ids, positions, k_pool, v_pool, block_tables,
     logits = x @ params["wte"].T                       # [B, V]
     tokens = sample_tokens(logits, temperature, top_k, seeds)
     return tokens, k_pool, v_pool
+
+
+def verify_step(params, ids, start_positions, k_pool, v_pool,
+                block_tables, context_lens, temperature, top_k,
+                seeds, *, n_head, eps, block_size, use_kernel=False,
+                interpret=False):
+    """Speculative-decode verification: T tokens per sequence in ONE
+    fixed-shape dispatch.
+
+    ids [B, T]: slot 0 is the sequence's pending token (sampled last
+    round, K/V unwritten), slots 1..T-1 the draft proposals. Token
+    (b, t) sits at absolute position `start_positions[b] + t` and
+    `context_lens[b] == start_positions[b] + 1` (slot 0 inclusive).
+    Each layer writes all T slots' K/V through the table BEFORE the
+    multi-query paged attention, so slot t sees slots 0..t (and
+    nothing deeper — per-slot causal masking). Returns
+    (tokens [B, T], k_pool, v_pool): tokens[b, t] is the target's
+    choice for output index context_lens[b] + t, sampled with
+    seeds[b, t] — the SAME position-keyed seed the k=1 engine would
+    use, which is what makes acceptance token-identical for any
+    temperature. Rejected slots' K/V writes land at positions beyond
+    the accepted context and are overwritten by a later dispatch
+    before any masked read could see them. `block_tables` may carry
+    a trailing guaranteed-NULL column: positions past the table's
+    real width clamp into it, so an at-cap sequence's overflow slots
+    write garbage to the NULL block instead of its own live tail."""
+    from ...incubate.nn.pallas import paged_attention as _pa
+
+    bsz, t_q = ids.shape
+    hidden = params["wte"].shape[1]
+    d = hidden // n_head
+    scale = 1.0 / math.sqrt(d)
+    positions = start_positions[:, None] \
+        + jnp.arange(t_q)[None, :]                     # [B, T]
+    x = jnp.take(params["wte"], ids, axis=0)
+    x = x + jnp.take(params["wpe"], positions, axis=0)
+
+    maxb = block_tables.shape[1]
+    slot_idx = jnp.minimum(positions // block_size, maxb - 1)
+    blk = jnp.take_along_axis(block_tables, slot_idx, axis=1)
+    off = positions % block_size
+
+    def body(carry, xs):
+        bp, kc, vc = xs
+        h = _layer_norm(carry, bp["ln1_w"], bp["ln1_b"], eps)
+        qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(bsz, t_q, n_head, d)
+        kc = kc.at[blk, off].set(
+            k.reshape(bsz, t_q, n_head, d).astype(kc.dtype))
+        vc = vc.at[blk, off].set(
+            v.reshape(bsz, t_q, n_head, d).astype(vc.dtype))
+        if use_kernel:
+            attn = _pa.paged_attention_multi(
+                q, kc, vc, block_tables, context_lens,
+                sm_scale=scale, interpret=interpret)
+        else:
+            attn = _pa.paged_attention_multi_reference(
+                q, kc, vc, block_tables, context_lens,
+                sm_scale=scale)
+        attn = attn.reshape(bsz, t_q, hidden)
+        attn = attn @ bp["proj_w"] + bp["proj_b"]
+        h2, x2 = _residual_layer_norm(attn, carry, bp["ln2_w"],
+                                      bp["ln2_b"], eps)
+        ffn = h2 @ bp["fc1_w"] + bp["fc1_b"]
+        ffn = jax.nn.gelu(ffn)
+        ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]
+        return x2 + ffn, (kc, vc)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], k_pool, v_pool))
+    x = _layer_norm(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = x @ params["wte"].T                       # [B, T, V]
+    vocab = logits.shape[-1]
+    flat = sample_tokens(
+        logits.reshape(bsz * t_q, vocab),
+        jnp.repeat(temperature, t_q), jnp.repeat(top_k, t_q),
+        seeds.reshape(bsz * t_q))
+    return flat.reshape(bsz, t_q), k_pool, v_pool
+
+
+def prefill_tail_step(params, ids, start, total_len, k_pool, v_pool,
+                      block_table, temperature, top_k, seed, *,
+                      n_head, eps, block_size):
+    """Prefix-cache tail prefill: causal forward over ONLY the
+    uncached tail of one request's context.
+
+    The leading `start` tokens (a multiple of block_size) already
+    have their K/V in the pools through shared table blocks; ids
+    [1, Tpad] holds the tail (block-padded), whose token t sits at
+    absolute position `start + t`. Each layer writes the tail's K/V
+    through the table, then attends over the WHOLE paged context via
+    the multi-query reference (slot t sees start + t + 1 tokens).
+    Samples from the last REAL tail row (`total_len - 1 - start`).
+    The tail is never empty — the engine caps sharing below the full
+    context, so the sampling row always exists. Returns
+    (first sampled token [], k_pool, v_pool)."""
+    from ...incubate.nn.pallas import paged_attention as _pa
+
+    t_pad = ids.shape[1]
+    hidden = params["wte"].shape[1]
+    d = hidden // n_head
+    scale = 1.0 / math.sqrt(d)
+    positions = start + jnp.arange(t_pad)
+    x = jnp.take(params["wte"], ids, axis=0)
+    x = x + jnp.take(params["wpe"], positions, axis=0)[None]
+
+    blk, off = _scatter_positions(block_table, positions, block_size)
+
+    def body(carry, xs):
+        bp, kc, vc = xs
+        h = _layer_norm(carry, bp["ln1_w"], bp["ln1_b"], eps)
+        qkv = h @ bp["qkv_w"] + bp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(1, t_pad, n_head, d)
+        kc = kc.at[blk, off].set(
+            k[0].reshape(t_pad, n_head, d).astype(kc.dtype))
+        vc = vc.at[blk, off].set(
+            v[0].reshape(t_pad, n_head, d).astype(vc.dtype))
+        # dense multi-query reference (T can be a whole prompt tail —
+        # too long for the unrolled kernel): slot t's context is
+        # (start + 1) + t tokens, cached prefix included
+        attn = _pa.paged_attention_multi_reference(
+            q, kc, vc, block_table[None], jnp.asarray([start + 1]),
+            sm_scale=scale)
+        attn = attn.reshape(1, t_pad, hidden)
+        attn = attn @ bp["proj_w"] + bp["proj_b"]
+        h2, x2 = _residual_layer_norm(attn, carry, bp["ln2_w"],
+                                      bp["ln2_b"], eps)
+        ffn = h2 @ bp["fc1_w"] + bp["fc1_b"]
+        ffn = jax.nn.gelu(ffn)
+        ffn = ffn @ bp["fc2_w"] + bp["fc2_b"]
+        return x2 + ffn, (kc, vc)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], k_pool, v_pool))
+    x = _layer_norm(x, params["lnf_w"], params["lnf_b"], eps)
+    last = jax.lax.dynamic_index_in_dim(
+        x[0], total_len - 1 - start, axis=0, keepdims=False)
+    logits = last @ params["wte"].T                    # [V]
+    token = sample_tokens(logits[None], temperature[None],
+                          top_k[None], seed[None])[0]
+    return token, k_pool, v_pool

@@ -44,7 +44,13 @@ unplaced exports are retained in `orphan_exports` (never silently
 dropped — the PTA073 class) and the wait raises.
 
 All replicas boot off the same `serve_decode:<Model>` persistent
-compile-cache entry (PR 8), so replica N is a warm start.
+compile-cache entry (PR 8), so replica N is a warm start. At boot
+the fleet negotiates ONE speculative-decoding config: every replica
+is built from the same kwargs, but per-engine clamping (a model too
+shallow for a draft twin, the kernel's window cap) can still leave
+them lopsided — the router settles on the weakest replica's window
+(`Router.spec_k`, `serve/spec/fleet_k`) and records the concession,
+so failover replays and serve/spec/* telemetry describe one fleet.
 
 Thread discipline: each worker wraps `engine.step()` in its replica's
 `step_lock`; router-side surgery (export/drain) takes the same lock
@@ -163,6 +169,26 @@ class Router:
             rep = _Replica(i, eng)
             self._replicas.append(rep)
             _cmon.stat_set(f"serve/replica/{i}/healthy", 1)
+        # -- spec-config negotiation (ISSUE 19) ----------------------
+        # Failover replays any request on any survivor, and while
+        # token identity holds at ANY spec window by contract, the
+        # fleet must still agree on ONE config or serve/spec/*
+        # telemetry and the k-aware admission promise stop meaning
+        # anything. All replicas are built from the same kwargs, so
+        # disagreement can only come from per-engine clamping (model
+        # too shallow for a draft twin, window capped at the kernel
+        # limit) — negotiate down to the weakest replica and record
+        # the concession instead of serving a lopsided fleet.
+        ks = sorted({r.engine.spec_k for r in self._replicas})
+        pcs = {bool(r.engine.prefix_cache) for r in self._replicas}
+        self.spec_k = ks[0]
+        self.prefix_cache = pcs == {True}
+        if len(ks) > 1 or len(pcs) > 1:
+            _flight.record("serve_spec_negotiate",
+                           spec_ks=ks, negotiated=self.spec_k,
+                           prefix=sorted(pcs))
+        if self.spec_k > 1:
+            _cmon.stat_set("serve/spec/fleet_k", self.spec_k)
         for rep in self._replicas:
             t = threading.Thread(
                 target=self._replica_loop, args=(rep,),
@@ -555,6 +581,8 @@ class Router:
         return {
             "replicas": len(self._replicas),
             "healthy": len(self._live()),
+            "spec_k": self.spec_k,
+            "prefix_cache": self.prefix_cache,
             "draining": self._draining,
             "records": len(self._records),
             "orphan_exports": len(self.orphan_exports),

@@ -10,9 +10,13 @@ policy half (the engine owns the dispatches):
 
   * FIFO admission: `add()` queues, `schedule()` admits while a batch
     slot AND the KV pool's admission check (`can_admit`: prompt
-    blocks + one decode-lookahead block) both say yes. Admission is a
-    chaos site (`serve_admit`) — slow clients and admission-time
-    faults inject there.
+    blocks — less any prefix-cached blocks — plus a decode lookahead
+    sized for the engine's speculative width k, since one verify
+    dispatch can land up to k tokens) both say yes. Admission goes
+    through `cache.admit()`, which maps cached prefix blocks
+    copy-on-write and charges only the uncached remainder. Admission
+    is a chaos site (`serve_admit`) — slow clients and
+    admission-time faults inject there.
   * Block growth: a running request crossing a block boundary asks
     `ensure_capacity()` for its next block before the dispatch that
     writes into it.
@@ -205,6 +209,13 @@ class Request:
         self.output_ids = []
         self.slot = None           # decode batch slot while RUNNING
         self.evictions = 0
+        # tokens covered by shared prefix blocks at LAST admission —
+        # the engine's prefill skips them (tail-only prefill)
+        self.cached_tokens = 0
+        # speculative-decode realign flag: True after a round accepts
+        # every proposal (one draft-KV position is then stale; the
+        # next round's realign step rewrites it)
+        self._spec_gap = False
         self.token_times = []      # perf_counter per emitted token
         self.arrival = time.monotonic()
         # TTFT/e2e latency anchor on the SAME clock as token_times
@@ -262,11 +273,20 @@ class Scheduler:
     decode batch width."""
 
     def __init__(self, cache, max_batch, max_seq_len,
-                 static_batching=False, max_queue=None):
+                 static_batching=False, max_queue=None,
+                 spec_tokens=1):
         self.cache = cache
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.static_batching = bool(static_batching)
+        # speculative width: one verify dispatch can append up to
+        # `spec_tokens` tokens, so admission's decode lookahead and
+        # ensure_capacity's growth target must both cover k — or the
+        # verify dispatch right after admission evicts what was just
+        # admitted
+        self.spec_tokens = max(1, int(spec_tokens))
+        self._lookahead = max(
+            1, math.ceil(self.spec_tokens / cache.block_size))
         self.max_queue = (env_max_queue() if max_queue is None
                           else max(0, int(max_queue)))
         self.draining = False      # drain(): stop admitting
@@ -371,7 +391,11 @@ class Scheduler:
         while slots and self.waiting:
             req = self.waiting[0]
             need_tokens = req.context_len
-            if not self.cache.can_admit(need_tokens):
+            ctx_ids = req.prompt_ids + req.output_ids
+            cached_blocks, _ = self.cache.probe_prefix(ctx_ids)
+            if not self.cache.can_admit(
+                    need_tokens, lookahead_blocks=self._lookahead,
+                    cached_blocks=cached_blocks):
                 break
             if _chaos._armed:
                 # slow-client / admission faults land here, BEFORE
@@ -379,10 +403,11 @@ class Scheduler:
                 _chaos.hit("serve_admit", req=req.req_id)
             self.waiting.popleft()
             nblocks = self.cache.blocks_for_tokens(need_tokens)
-            got = self.cache.allocator.alloc(req.req_id, nblocks)
-            if got is None:        # raced the lookahead margin
+            cached = self.cache.admit(req.req_id, ctx_ids)
+            if cached is None:     # raced the lookahead margin
                 self._requeue_front(req)
                 break
+            req.cached_tokens = cached
             req.state = RUNNING
             req.slot = slots.pop(0)
             self.running[req.slot] = req
@@ -407,17 +432,22 @@ class Scheduler:
         return admitted
 
     # -- block growth / preemption -----------------------------------
-    def ensure_capacity(self, request):
-        """Grow the request's table to cover its next token; evicts
-        other requests under pool pressure. False when the request
-        itself had to be evicted (pool too small even after evicting
+    def ensure_capacity(self, request, new_tokens=None):
+        """Grow the request's table to cover its next `new_tokens`
+        tokens (default: the scheduler's speculative width — a
+        verify dispatch may land up to k at once); evicts other
+        requests under pool pressure. False when the request itself
+        had to be evicted (pool too small even after evicting
         everyone younger) — or was ALREADY evicted by an earlier
         grow in the same pass (growing a non-running request would
         allocate blocks no dispatch ever uses: the PTA070 leak the
         serving sanitizer hunts)."""
         if self.running.get(request.slot) is not request:
             return False
-        need = self.cache.blocks_for_tokens(request.context_len + 1)
+        if new_tokens is None:
+            new_tokens = self.spec_tokens
+        need = self.cache.blocks_for_tokens(
+            request.context_len + new_tokens)
         while len(self.cache.allocator.owned(request.req_id)) < need:
             got = self.cache.allocator.alloc(request.req_id, 1)
             if got is not None:
@@ -450,6 +480,8 @@ class Scheduler:
         self.running.pop(request.slot, None)
         self.cache.allocator.release(request.req_id)
         self._admitted_at.pop(request.req_id, None)
+        request.cached_tokens = 0   # re-admission re-probes
+        request._spec_gap = False   # re-prefill rewrites draft KV
         request.evictions += 1
         self._requeue_front(request)
         _cmon.stat_add("serve/evictions", 1)

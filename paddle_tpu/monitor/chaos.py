@@ -22,6 +22,7 @@ Sites (see SITES; `python -m paddle_tpu.monitor chaos` lists them):
     serve_decode serving-engine decode dispatch (LLMEngine)
     serve_route  serving-router replica selection (Router)
     serve_drain  serving-engine graceful drain (LLMEngine.drain)
+    serve_spec_verify  speculative-decode draft verification (LLMEngine)
 
 Spec grammar (PADDLE_CHAOS, `;`-separated rules):
 
@@ -97,6 +98,11 @@ SITES = {
     "serve_drain": "serving-engine graceful drain entry "
                    "(inference.serving.engine.drain — raise = drain "
                    "aborted before any request is exported)",
+    "serve_spec_verify": "speculative-decode draft verification "
+                         "(inference.serving.engine — corrupt forces "
+                         "every draft to diverge; acceptance degrades "
+                         "to 1 token/round, emitted tokens stay "
+                         "identical)",
     "linalg_dispatch": "distributed linear-algebra program dispatch "
                        "(linalg.dist.runtime.dispatch — SUMMA/"
                        "factorization/eigensolver programs)",
@@ -125,6 +131,10 @@ FAULTS = {
     "bitflip": "site-interpreted wire corruption: the quantized "
                "allreduce XORs bit 6 into every code of scale "
                "block 0 (comm_compress)",
+    "corrupt": "site-interpreted draft corruption: the serving engine "
+               "replaces every speculative draft proposal in the "
+               "round, forcing verification to reject them all "
+               "(serve_spec_verify)",
 }
 
 PARAMS = {
@@ -185,7 +195,8 @@ _FLOAT_PARAMS = ("p", "ms", "secs")
 # the returned Rule — arming them elsewhere would count `triggered`
 # injections that never happened, corrupting the chaos/* provenance
 _SITE_INTERPRETED = {"torn": ("ckpt_write", "cache_write"),
-                     "bitflip": ("comm_compress",)}
+                     "bitflip": ("comm_compress",),
+                     "corrupt": ("serve_spec_verify",)}
 
 
 def _default_seed(site, fault):

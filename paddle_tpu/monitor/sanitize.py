@@ -35,10 +35,14 @@ Families (PADDLE_SANITIZE, `,`/`;`-separated, chaos-style grammar):
     serving     KV-block accounting in the serving engine
                 (inference.serving.kv_cache): double-free /
                 foreign-free of a pool block reports PTA071 at the
-                faulting call, and the allocator's
+                faulting call, the allocator's
                 `audit_leaks(live)` / `LLMEngine.check_drained()`
                 report PTA070 for blocks still owned by requests
-                the engine no longer tracks.
+                the engine no longer tracks, and refcount/COW
+                violations over prefix-cache-shared blocks (a block
+                physically reclaimed while other requests still map
+                it, or a shared block mutated without copy-on-write)
+                report PTA074 at the faulting call.
     numerics    precision sanitizer (PTA09x): the TrainStepCompiler
                 fuses a per-tensor absmax/absmin/nonfinite stats
                 probe over loss/grads/params (host-read every
@@ -94,8 +98,9 @@ FAMILIES = {
              "census (PTA060/PTA061/PTA063)",
     "sharding": "strict mode for the PTA05x sharding-spec lints "
                 "(errors raise before compile)",
-    "serving": "KV-block leak/double-free accounting in the serving "
-               "engine (PTA070/PTA071)",
+    "serving": "KV-block leak/double-free + prefix-cache refcount/COW "
+               "accounting in the serving engine "
+               "(PTA070/PTA071/PTA074)",
     "compress": "quantized-collective invariants: error-feedback "
                 "residual never donated (PTA080), quantized "
                 "allreduce on a non-SUM op / integer dtype "

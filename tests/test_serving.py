@@ -871,11 +871,25 @@ class TestServingDocDrift:
     def test_serving_section_and_codes(self):
         doc = self._readme()
         assert "## Serving" in doc
-        for code in ("PTA070", "PTA071", "PTA072", "PTA073"):
+        for code in ("PTA070", "PTA071", "PTA072", "PTA073",
+                     "PTA074"):
             assert code in doc, f"{code} missing from README"
         for site in ("serve_admit", "serve_decode", "serve_route",
-                     "serve_drain"):
+                     "serve_drain", "serve_spec_verify"):
             assert site in doc, f"chaos site {site} undocumented"
+
+    def test_spec_and_prefix_sections(self):
+        """ISSUE-19 satellite: the README documents the speculative-
+        decoding + prefix-caching surface — knobs, counters, chaos
+        site, sanitizer code, bench twin."""
+        doc = self._readme()
+        assert "Speculative decoding" in doc
+        assert "Prefix caching" in doc
+        for word in ("serve/spec/", "serve/hist/accept_len",
+                     "serve/prefix/prefill_tokens_saved",
+                     "copy-on-write", "check_cow",
+                     "extra.serve_spec", "spec_k", "prefix_cache"):
+            assert word in doc, f"{word!r} missing from README"
 
     def test_resilience_section(self):
         """ISSUE-13 satellite: the README documents the resilience
@@ -889,3 +903,465 @@ class TestServingDocDrift:
                      "import_request"):
             assert word in doc, f"{word!r} missing from README"
         assert "LLMEngine" in doc
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: prefix-cache refcounts + copy-on-write (allocator/cache)
+# ---------------------------------------------------------------------------
+
+class TestRefcountsAndPrefixIndex:
+    def test_double_share_then_single_free(self):
+        a = BlockAllocator(8)
+        (b0,) = a.alloc("a", 1)
+        a.share("x", b0)
+        a.share("y", b0)
+        assert a.refcount(b0) == 3
+        # dropping one reference must NOT reclaim the block
+        assert a.release("a") == 1
+        assert a.refcount(b0) == 2 and a.free_blocks == 6
+        a.free_one("x", b0)
+        assert a.refcount(b0) == 1 and a.free_blocks == 6
+        a.release("y")  # last reference: now it really frees
+        assert a.refcount(b0) == 0 and a.free_blocks == 7
+
+    def test_share_unallocated_or_null_raises(self):
+        a = BlockAllocator(8)
+        with pytest.raises(ValueError):
+            a.share("x", NULL_BLOCK)
+        with pytest.raises(ValueError):
+            a.share("x", 5)  # never allocated
+
+    def test_check_cow_blocks_shared_writes(self):
+        a = BlockAllocator(8)
+        (b0,) = a.alloc("a", 1)
+        assert a.check_cow(b0) == b0  # sole owner: writable
+        a.share("b", b0)
+        with pytest.raises(ValueError):
+            a.check_cow(b0)  # shared: immutable
+
+    def test_eviction_of_sharer_never_reclaims_shared_blocks(self):
+        c = PagedKVCache(1, 2, 8, block_size=4, num_blocks=10,
+                         prefix_cache=True)
+        toks = list(range(1, 10))  # 2 full blocks + 1 tail token
+        assert c.admit("r1", toks) == 0  # cold cache
+        c.register_prefix("r1", toks)
+        assert c.admit("r2", toks) == 8  # shares the 2 full blocks
+        shared = c.allocator.owned("r2")[:2]
+        assert shared == c.allocator.owned("r1")[:2]
+        free_before = c.allocator.free_blocks
+        c.allocator.release("r2")  # evict the sharer
+        # only r2's PRIVATE tail block returned; the shared pair stays
+        assert c.allocator.free_blocks == free_before + 1
+        for b in shared:
+            assert c.allocator.refcount(b) == 1
+        assert c.allocator.owned("r1")[:2] == shared
+
+    def test_can_admit_accounts_cached_blocks(self):
+        c = PagedKVCache(1, 2, 8, block_size=8, num_blocks=6)
+        # 5 usable blocks: a 5-block prompt + 1 lookahead won't fit...
+        assert not c.can_admit(8 * 5)
+        # ...unless 2 of its blocks are already cached
+        assert c.can_admit(8 * 5, cached_blocks=2)
+        # k-aware decode lookahead eats into the same budget
+        assert c.can_admit(8 * 2, lookahead_blocks=3)
+        assert not c.can_admit(8 * 2, lookahead_blocks=4)
+
+    def test_last_free_deregisters_hash(self):
+        c = PagedKVCache(1, 2, 8, block_size=4, num_blocks=8,
+                         prefix_cache=True)
+        toks = list(range(1, 10))
+        c.admit("r1", toks)
+        c.register_prefix("r1", toks)
+        digs = list(c.allocator._by_hash)
+        assert len(digs) == 2
+        c.allocator.release("r1")
+        for d in digs:
+            assert c.allocator.lookup_hash(d) is None
+        assert c.admit("r2", toks) == 0  # cold again, no stale hit
+
+    def test_defrag_preserves_both_sharers_tables(self):
+        import jax.numpy as jnp
+
+        c = PagedKVCache(1, 2, 4, block_size=2, num_blocks=12,
+                         prefix_cache=True)
+        c.allocator.alloc("hole", 3)
+        toks = [5, 6, 7, 8, 9]  # 2 full blocks + 1 tail token
+        assert c.admit("a", toks) == 0
+        c.register_prefix("a", toks)
+        assert c.admit("b", toks) == 4
+        c.allocator.release("hole")  # holes at the front
+        # stamp each block with its id so moves are detectable
+        c.k = jnp.arange(c.num_blocks, dtype=c.k.dtype).reshape(
+            1, -1, 1, 1, 1) * jnp.ones_like(c.k)
+        a_before = c.allocator.owned("a")
+        b_before = c.allocator.owned("b")
+        stamps = {blk: float(c.k[0, blk, 0, 0, 0])
+                  for blk in set(a_before + b_before)}
+        digest_of = dict(c.allocator._hash_of)
+        assert c.defrag() > 0
+        a_after = c.allocator.owned("a")
+        b_after = c.allocator.owned("b")
+        # the shared leading pair moved ONCE and leads BOTH tables
+        assert a_after[:2] == b_after[:2]
+        assert a_after[2] != b_after[2]  # private tails stay private
+        for old, new in zip(a_before, a_after):
+            assert float(c.k[0, new, 0, 0, 0]) == stamps[old]
+        for old, new in zip(b_before, b_after):
+            assert float(c.k[0, new, 0, 0, 0]) == stamps[old]
+        # refcounts and the content-hash index moved with the blocks
+        for blk in a_after[:2]:
+            assert c.allocator.refcount(blk) == 2
+        remap = dict(zip(a_before, a_after))
+        for old, dig in digest_of.items():
+            assert c.allocator.lookup_hash(dig) == remap[old]
+        # a third admission still shares post-defrag
+        assert c.admit("c2", toks) == 4
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: multi-query verify kernel
+# ---------------------------------------------------------------------------
+
+class TestMultiQueryKernel:
+    def _rand(self, b=4, t=8, h=4, d=32, bs=8, n=24, maxb=6):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+        kp = jnp.asarray(rng.randn(n, bs, h, d), jnp.float32)
+        vp = jnp.asarray(rng.randn(n, bs, h, d), jnp.float32)
+        bt = jnp.asarray(rng.randint(1, n, (b, maxb)), jnp.int32)
+        return q, kp, vp, bt
+
+    @pytest.mark.parametrize("t,lens", [
+        (2, (1, 8, 9, 15)),    # around block boundaries
+        (4, (8, 16, 3, 23)),
+        (8, (1, 5, 17, 33)),   # widest supported window
+    ])
+    def test_interpret_parity_vs_dense(self, t, lens):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn.pallas.paged_attention import (
+            paged_attention_multi, paged_attention_multi_reference)
+
+        q, kp, vp, bt = self._rand(t=t)
+        cl = jnp.asarray(np.array(lens, np.int32))
+        out = paged_attention_multi(q, kp, vp, bt, cl, sm_scale=0.2,
+                                    interpret=True)
+        ref = paged_attention_multi_reference(q, kp, vp, bt, cl,
+                                              sm_scale=0.2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_window_too_wide_rejected(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn.pallas.paged_attention import (
+            paged_attention_multi)
+
+        q, kp, vp, bt = self._rand(t=9)
+        cl = jnp.asarray(np.array([1, 2, 3, 4], np.int32))
+        with pytest.raises(ValueError):
+            paged_attention_multi(q, kp, vp, bt, cl, interpret=True)
+
+    def test_slot0_matches_single_query_kernel(self):
+        """A 1-slot window is exactly the decode kernel: slot 0 sees
+        context_lens tokens — same math, same masking."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn.pallas.paged_attention import (
+            paged_attention, paged_attention_multi)
+
+        q, kp, vp, bt = self._rand(t=1)
+        cl = jnp.asarray(np.array([9, 3, 17, 8], np.int32))
+        multi = paged_attention_multi(q, kp, vp, bt, cl,
+                                      sm_scale=0.3, interpret=True)
+        single = paged_attention(q[:, 0], kp, vp, bt, cl,
+                                 sm_scale=0.3, interpret=True)
+        np.testing.assert_allclose(np.asarray(multi[:, 0]),
+                                   np.asarray(single),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_positions_past_window_never_read(self):
+        """Per-slot causal masking: slot t sees context_lens + t
+        tokens, so nothing past position context_lens + T - 2 is
+        live — poisoning the rest of the pool can't change either
+        the kernel's or the reference's output."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn.pallas.paged_attention import (
+            paged_attention_multi, paged_attention_multi_reference)
+
+        T = 4
+        q, kp, vp, bt = self._rand(t=T)
+        cl_np = np.array([9, 3, 17, 8], np.int32)
+        cl = jnp.asarray(cl_np)
+        out = paged_attention_multi(q, kp, vp, bt, cl, sm_scale=0.3,
+                                    interpret=True)
+        ref = paged_attention_multi_reference(q, kp, vp, bt, cl,
+                                              sm_scale=0.3)
+        live = np.zeros((kp.shape[0], kp.shape[1]), bool)
+        bt_np = np.asarray(bt)
+        for b in range(len(cl_np)):
+            for p in range(cl_np[b] + T - 1):  # widest slot's view
+                live[bt_np[b, p // 8], p % 8] = True
+        mask = jnp.asarray(live)[:, :, None, None]
+        pk = jnp.where(mask, kp, 1e9)
+        pv = jnp.where(mask, vp, -1e9)
+        out2 = paged_attention_multi(q, pk, pv, bt, cl,
+                                     sm_scale=0.3, interpret=True)
+        ref2 = paged_attention_multi_reference(q, pk, pv, bt, cl,
+                                               sm_scale=0.3)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(ref),
+                                      np.asarray(ref2))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: speculative decoding + prefix caching e2e
+# ---------------------------------------------------------------------------
+
+def _counter_deltas(prefixes, fn):
+    """Run fn() and return (result, {counter: delta}) for stats under
+    the given name prefixes. Uses registry.snapshot() — which never
+    CREATES stats — so zero-delta assertions can't self-satisfy."""
+    before = {k: v for k, v in cmon.registry.snapshot().items()
+              if k.startswith(prefixes)}
+    out = fn()
+    after = {k: v for k, v in cmon.registry.snapshot().items()
+             if k.startswith(prefixes)}
+    deltas = {k: after[k] - before.get(k, 0) for k in after
+              if after[k] != before.get(k, 0)}
+    return out, deltas
+
+
+class _SpecRig:
+    """Shared model + engines for the ISSUE-19 e2e suite. Every
+    LLMEngine construction pays XLA compiles for its whole program
+    set on CPU, so each configuration is built ONCE and reused —
+    engines drain completely between tests, and token identity is
+    batch-composition-independent by contract, so reuse is safe."""
+
+    def __init__(self):
+        self.model = tiny_model()
+        rng = np.random.RandomState(1)
+        # lens capped at 16 so the spec arms compile one fewer
+        # prefill bucket — raggedness, not bucket count, is what the
+        # identity gate exercises
+        self.prompts = [list(rng.randint(1, 128, n))
+                        for n in (1, 3, 8, 9, 13, 16, 14, 5)]
+        prng = np.random.RandomState(6)
+        self.prefix = list(prng.randint(1, 128, 16))  # 2 full blocks
+        self.pfx_prompts = [self.prefix
+                            + list(prng.randint(1, 128, n))
+                            for n in (5, 9)]
+        self.sp = SamplingParams(max_new_tokens=8)
+        self._engines = {}
+        self._want = {}
+
+    def engine(self, key, **kw):
+        if key not in self._engines:
+            self._engines[key] = LLMEngine(
+                self.model, max_batch=4, block_size=8,
+                num_blocks=kw.pop("num_blocks", 64), **kw)
+        return self._engines[key]
+
+    def want(self, which="mixed"):
+        """k=1/no-cache reference outputs from the shared baseline
+        engine, computed once per prompt set."""
+        if which not in self._want:
+            prompts = (self.prompts if which == "mixed"
+                       else self.pfx_prompts)
+            self._want[which] = self.engine("base").generate(
+                prompts, sampling=self.sp)
+            assert self.engine("base").check_drained() == {}
+        return self._want[which]
+
+
+@pytest.fixture(scope="module")
+def rig():
+    return _SpecRig()
+
+
+class TestSpeculativeDecodeE2E:
+    def test_greedy_token_identity_all_k(self, rig):
+        """ISSUE-19 gate: greedy spec decoding at k in {2, 4, 8} is
+        token-identical to the k=1 baseline across 8 concurrent
+        mixed-length requests."""
+        want = rig.want()
+        # ground the baseline itself against the sequential reference
+        assert want[3] == ref_greedy(rig.model, rig.prompts[3], 8)
+        hist0 = cmon.hist_get("serve/hist/accept_len").count
+        for k in (2, 4, 8):
+            eng = rig.engine(f"k{k}", spec_k=k)
+            got, deltas = _counter_deltas(
+                ("serve/spec/",),
+                lambda: eng.generate(rig.prompts, sampling=rig.sp))
+            assert got == want, f"spec_k={k} diverged from k=1"
+            assert eng.check_drained() == {}
+            assert eng.cache.allocator.used_blocks == 0
+            assert deltas.get("serve/spec/proposed", 0) > 0
+            assert 0 < deltas.get("serve/spec/accepted", 0) \
+                <= deltas["serve/spec/proposed"]
+            assert eng.state_summary()["spec_k"] == k
+        assert cmon.hist_get("serve/hist/accept_len").count > hist0
+
+    def test_temperature_identity(self, rig):
+        """Verification re-samples every slot with the baseline's
+        position-keyed seeds, so spec == k=1 holds at ANY
+        temperature, not just greedy."""
+        def run(eng):
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=6, temperature=0.9,
+                                  top_k=20, seed=7 + i))
+                for i, p in enumerate(rig.prompts)]
+            while eng.has_unfinished():
+                eng.step()
+            outs = [list(eng.get_request(r).output_ids)
+                    for r in rids]
+            assert eng.check_drained() == {}
+            return outs
+
+        assert run(rig.engine("k4", spec_k=4)) \
+            == run(rig.engine("base"))
+
+    def test_chaos_corrupt_storm_degrades_not_diverges(self, rig):
+        """serve_spec_verify:corrupt replaces EVERY draft proposal:
+        acceptance collapses to the guaranteed 1 token/round floor
+        but the emitted tokens stay identical to baseline."""
+        eng = rig.engine("k4", spec_k=4)
+        with chaos.inject("serve_spec_verify", "corrupt") as rule:
+            got, deltas = _counter_deltas(
+                ("serve/spec/",),
+                lambda: eng.generate(rig.prompts, sampling=rig.sp))
+        assert got == rig.want()
+        assert rule.triggers > 0
+        # corrupted drafts only survive verification by COINCIDING
+        # with the target's own choice — acceptance collapses from
+        # ~100% to (near) zero while throughput floors at 1/round
+        assert deltas["serve/spec/proposed"] > 0
+        assert deltas.get("serve/spec/accepted", 0) \
+            <= deltas["serve/spec/proposed"] * 0.2
+        assert eng.check_drained() == {}
+
+    def test_disarmed_paths_leave_zero_spec_prefix_counters(self, rig):
+        """spec_k=1 + prefix_cache off is the pre-PR engine: no draft
+        pools, no serve/spec/* or serve/prefix/* counter motion."""
+        eng = rig.engine("base")
+        assert eng.cache.k_draft is None
+        assert eng.cache.v_draft is None
+        _, deltas = _counter_deltas(
+            ("serve/spec/", "serve/prefix/"),
+            lambda: eng.generate(rig.prompts[:4], sampling=rig.sp))
+        assert deltas == {}
+        s = eng.state_summary()
+        assert s["spec_k"] == 1 and s["prefix_cache"] is False
+
+
+class TestPrefixCacheE2E:
+    def test_shared_prefix_prefills_tail_only(self, rig):
+        """Two requests sharing a 2-full-block prefix: the second
+        maps the published blocks copy-on-write and prefills ONLY its
+        uncached tail — tokens identical to the cache-off engine."""
+        prompts = rig.pfx_prompts
+        eng = rig.engine("prefix", prefix_cache=True)
+        got, deltas = _counter_deltas(
+            ("serve/prefix/",),
+            lambda: eng.generate(prompts, sampling=rig.sp))
+        assert got == rig.want("pfx")
+        assert deltas["serve/prefix/hits"] == 1
+        assert deltas["serve/prefix/blocks_shared"] == 2
+        assert deltas["serve/prefix/prefill_tokens_saved"] == 16
+        assert eng.check_drained() == {}
+        assert eng.cache.allocator.used_blocks == 0
+
+    def test_eviction_replay_spec_prefix_zero_leaks(self, rig):
+        """The everything-on stress: spec_k=4 + prefix caching on a
+        pool too small for the working set. Evicting a request whose
+        table maps shared blocks must release only its references,
+        mid-spec-round preemption must replay token-exactly, and the
+        drained pool is empty — outputs identical to the plain k=1
+        cache-off engine."""
+        rng = np.random.RandomState(7)
+        prefix = list(rng.randint(1, 128, 16))
+        prompts = [prefix + list(rng.randint(1, 128, n))
+                   for n in (3, 7, 11, 5, 9, 2)]
+        want = rig.engine("base").generate(prompts, sampling=rig.sp)
+        evict0 = cmon.stat_get("serve/evictions")
+        tight = rig.engine("tight", num_blocks=11, spec_k=4,
+                           prefix_cache=True)
+        got = tight.generate(prompts, sampling=rig.sp)
+        assert got == want
+        assert cmon.stat_get("serve/evictions") > evict0
+        assert tight.check_drained() == {}
+        assert tight.cache.allocator.used_blocks == 0
+        s = tight.state_summary()
+        assert s["spec_k"] == 4 and s["prefix_cache"] is True
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: PTA074 — refcount/COW sanitizer (runtime + static)
+# ---------------------------------------------------------------------------
+
+class TestPTA074:
+    def test_runtime_cow_finding(self):
+        msan.configure("serving")
+        try:
+            msan.clear_findings()
+            a = BlockAllocator(8)
+            (b0,) = a.alloc("a", 1)
+            a.share("b", b0)
+            before = cmon.stat_get("analysis/PTA074/findings")
+            with pytest.raises(ValueError):
+                a.check_cow(b0)
+            assert cmon.stat_get(
+                "analysis/PTA074/findings") == before + 1
+            assert "PTA074" in [f.code for f in msan.findings()]
+        finally:
+            msan.disarm()
+            msan.clear_findings()
+
+    def test_runtime_lost_refcount_reclaim_finding(self):
+        """The defensive half: a block physically reclaimed while
+        some OTHER owner's table still maps it means a refcount was
+        lost — the allocator reports it at the faulting deref."""
+        msan.configure("serving")
+        try:
+            msan.clear_findings()
+            a = BlockAllocator(8)
+            (b0,) = a.alloc("a", 1)
+            a.share("b", b0)
+            a._refcnt[b0] = 1  # simulate the lost refcount
+            before = cmon.stat_get("analysis/PTA074/findings")
+            a.release("a")  # reclaims while "b" still maps b0
+            assert cmon.stat_get(
+                "analysis/PTA074/findings") == before + 1
+        finally:
+            msan.disarm()
+            msan.clear_findings()
+
+    def test_disarmed_cow_still_raises_but_silent(self):
+        assert not msan.armed("serving")
+        a = BlockAllocator(8)
+        (b0,) = a.alloc("a", 1)
+        a.share("b", b0)
+        before = cmon.stat_get("analysis/PTA074/findings")
+        with pytest.raises(ValueError):
+            a.check_cow(b0)
+        assert cmon.stat_get(
+            "analysis/PTA074/findings") == before
+
+    def test_static_lint_private_reach(self):
+        from paddle_tpu.analysis.serving import lint_kv_source
+
+        bad = ("def steal(alloc, b):\n"
+               "    alloc._free.append(b)\n"
+               "    del alloc._refcnt[b]\n")
+        rep = lint_kv_source(bad, filename="x.py")
+        assert [f.code for f in rep.findings] == ["PTA074",
+                                                  "PTA074"]
+        # `self._free` is some other class's own field — clean
+        good = ("class Pool:\n"
+                "    def free(self, b):\n"
+                "        self._free.append(b)\n")
+        assert lint_kv_source(good, filename="x.py").findings == []
+        # the allocator module itself is exempt
+        assert lint_kv_source(
+            bad, filename="kv_cache.py").findings == []

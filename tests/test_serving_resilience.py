@@ -480,6 +480,28 @@ class TestRouter:
         finally:
             router.shutdown()
 
+    def test_fleet_negotiates_spec_config(self, model, prompts,
+                                          want):
+        """ISSUE 19: replicas negotiate ONE speculative-decoding
+        config at boot — the fleet settles on the weakest replica's
+        window, exposes it in state_summary, and a spec+prefix fleet
+        still reproduces the plain single-engine reference
+        token-for-token."""
+        router = Router(model, replicas=2, max_batch=4, block_size=8,
+                        num_blocks=32, heartbeat_timeout_s=60.0,
+                        spec_k=4, prefix_cache=True)
+        try:
+            s = router.state_summary()
+            assert s["spec_k"] == 4 and s["prefix_cache"] is True
+            assert {e["spec_k"] for e in s["engines"]} == {4}
+            assert cmon.stat_get("serve/spec/fleet_k") == 4
+            outs = router.generate(prompts, sampling=sp(),
+                                   timeout_s=120)
+            assert outs == want
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
     def test_least_loaded_routing_by_free_blocks(self, model,
                                                  prompts):
         router = Router(model, replicas=2, max_batch=4, block_size=8,
