@@ -1143,6 +1143,18 @@ def main(argv=None):
                                  "analysis/PTA07",
                                  "analysis/PTA08",
                                  "analysis/PTA09"))}}
+        # SLO alert provenance (ISSUE 20): which PADDLE_ALERTS rules
+        # were armed for this run, each rule's terminal state, and
+        # every alerts/* + serve/autoscale/* counter — a bench round
+        # that burned its SLOs (or silently grew replicas) names it
+        from paddle_tpu.monitor import alerts as _alerts
+
+        results["alerts"] = {
+            "armed": [r.name for r in _alerts.rules()],
+            "rules": [r.describe() for r in _alerts.rules()],
+            "counters": {
+                k: v for k, v in stats.items()
+                if k.startswith(("alerts/", "serve/autoscale/"))}}
         # serving-engine attribution (ISSUE 11): request/token
         # volumes, prefill vs decode wall time, KV-pool occupancy
         # and the eviction counts behind the serving config's
@@ -1253,6 +1265,18 @@ def main(argv=None):
                   and k != "sanitize/spec_errors"}
         assert not leaked, (
             "disarmed sanitizers left counters behind "
+            f"(zero-overhead contract broken): {leaked}")
+    # same contract for the alert plane (ISSUE 20): with
+    # PADDLE_ALERTS unset there is no evaluator thread and no
+    # autoscaler listener, so EVERY alerts/* and serve/autoscale/*
+    # counter must be exactly absent (alerts/spec_errors records a
+    # rejected spec — loudness, not armed overhead)
+    al_extra = results.get("alerts")
+    if al_extra is not None and not al_extra["armed"]:
+        leaked = {k: v for k, v in al_extra["counters"].items()
+                  if k != "alerts/spec_errors"}
+        assert not leaked, (
+            "disarmed alert/autoscale plane left counters behind "
             f"(zero-overhead contract broken): {leaked}")
     # same contract for the perf plane: PADDLE_PERF_PROGRAM=0 must
     # leave the perf/program/* ledger empty — a disarmed opt-out that

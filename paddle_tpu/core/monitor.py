@@ -203,14 +203,17 @@ class Histogram:
                 self._max = mx
         return self
 
-    def quantile(self, q):
+    def quantile(self, q, empty=0.0):
         """Approximate q-quantile (0 <= q <= 1): the sorted-list rank
         `min(n-1, int(n*q))`, log-interpolated within its bucket and
-        clamped to the observed [min, max]. 0.0 when empty."""
+        clamped to the observed [min, max]. `empty` (default 0.0 for
+        the legacy display callers) is returned when the histogram
+        holds no observations — alert evaluation passes None so an
+        empty traffic window reads "no data", never a fake 0us p99."""
         with self._lock:
             return _quantile_locked(
                 self._counts, self._count, self._min, self._max,
-                self.lo, self.per_decade, q)
+                self.lo, self.per_decade, q, empty=empty)
 
     def snapshot(self):
         """Consistent JSON-ready copy: exact count/sum/min/max plus
@@ -228,6 +231,48 @@ class Histogram:
                 "decades": self.decades,
                 "buckets": buckets,
             }
+
+    def delta_since(self, snap):
+        """Windowed view (ISSUE 20): the observations recorded since
+        `snap` — an earlier snapshot() of THIS histogram — as a
+        snapshot-shaped dict, so cumulative buckets cannot mask a
+        recent regression (a week of healthy p99 would otherwise
+        outvote the last minute's storm). Bucket counts, count and
+        sum subtract exactly; the window's true min/max are NOT
+        recoverable from two cumulative readings, so they come back
+        None and snapshot_quantile resolves edge buckets against the
+        bucket boundaries instead (all-underflow windows return its
+        `empty` sentinel — satellite 2). `snap=None` means "since
+        forever" (the full cumulative view, exact min/max included).
+        A reset() between the two readings shows up as negative
+        deltas — the window restarts at the reset, so the CURRENT
+        cumulative state IS the window. Raises ValueError when `snap`
+        was taken under different bucket boundaries."""
+        if snap is None:
+            return self.snapshot()
+        if _snap_bounds(snap) != self._bounds():
+            raise ValueError(
+                f"delta_since: snapshot boundaries "
+                f"{_snap_bounds(snap)} != {self._bounds()}")
+        old = _snap_counts(snap)
+        with self._lock:
+            counts = [c - o for c, o in zip(self._counts, old)]
+            count = self._count - int(snap.get("count", 0))
+            total = self._sum - float(snap.get("sum", 0.0))
+            if count < 0 or any(c < 0 for c in counts):
+                counts = list(self._counts)
+                count = self._count
+                total = self._sum
+        return {
+            "count": count,
+            "sum": total,
+            "min": None,
+            "max": None,
+            "lo": self.lo,
+            "per_decade": self.per_decade,
+            "decades": self.decades,
+            "buckets": {i: c for i, c in enumerate(counts) if c},
+        }
 
 
 def _snap_bounds(snap):
@@ -253,9 +298,17 @@ def _snap_max(snap):
     return -math.inf if v is None else float(v)
 
 
-def _quantile_locked(counts, count, vmin, vmax, lo, per_decade, q):
+def _quantile_locked(counts, count, vmin, vmax, lo, per_decade, q,
+                     empty=0.0):
+    """Satellite-2 edge contract: `empty` comes back for a window
+    with no observations AND for a rank landing in the underflow
+    bucket of a windowed delta (min/max unknown — reporting `lo`
+    there would be a fake p99); an overflow rank without a known max
+    degrades to the top bucket edge, an honest LOWER bound (masking
+    an over-range p99 behind the sentinel would hide exactly the
+    regressions alerting exists to catch)."""
     if count <= 0:
-        return 0.0
+        return empty
     q = min(1.0, max(0.0, float(q)))
     nb = len(counts) - 2
     # rank matches sorted(v)[min(n-1, int(n*q))] (1-based rank)
@@ -266,26 +319,34 @@ def _quantile_locked(counts, count, vmin, vmax, lo, per_decade, q):
             continue
         if cum + c >= target:
             if idx == 0:            # underflow: everything <= lo
-                return vmin
+                return vmin if math.isfinite(vmin) else empty
             if idx == nb + 1:       # overflow
-                return vmax
+                return vmax if math.isfinite(vmax) else \
+                    lo * 10.0 ** (nb / per_decade)
             lower = lo * 10.0 ** ((idx - 1) / per_decade)
             upper = lo * 10.0 ** (idx / per_decade)
             frac = (target - cum) / c
             val = lower * (upper / lower) ** frac
-            return min(max(val, vmin), vmax)
+            if math.isfinite(vmin):
+                val = max(val, vmin)
+            if math.isfinite(vmax):
+                val = min(val, vmax)
+            return val
         cum += c
-    return vmax
+    return vmax if math.isfinite(vmax) else \
+        lo * 10.0 ** (nb / per_decade)
 
 
-def snapshot_quantile(snap, q):
-    """quantile(q) over a Histogram.snapshot() dict — the offline
-    flavor the fleet aggregator and bench extra.latency use on
-    spooled (JSON round-tripped) histograms."""
+def snapshot_quantile(snap, q, empty=0.0):
+    """quantile(q) over a Histogram.snapshot() (or delta_since())
+    dict — the offline flavor the fleet aggregator, bench
+    extra.latency and the alert engine use on spooled/windowed
+    histograms. `empty` is the no-data sentinel (see Histogram
+    .quantile)."""
     return _quantile_locked(
         _snap_counts(snap), int(snap.get("count", 0)),
         _snap_min(snap), _snap_max(snap), float(snap["lo"]),
-        int(snap["per_decade"]), q)
+        int(snap["per_decade"]), q, empty=empty)
 
 
 class StatRegistry:

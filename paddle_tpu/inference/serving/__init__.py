@@ -28,6 +28,7 @@ from __future__ import annotations
 from .engine import EngineTimeout, LLMEngine
 from .kv_cache import (BlockAllocator, NULL_BLOCK, PagedKVCache,
                        env_block_size, env_max_batch, env_pool_bytes)
+from .autoscaler import Autoscaler, maybe_autoscale
 from .router import Router, env_heartbeat_s, env_replicas
 from .scheduler import (EngineOverloaded, Request, SamplingParams,
                         Scheduler, env_deadline_s, env_max_queue)
@@ -37,4 +38,4 @@ __all__ = ["LLMEngine", "SamplingParams", "Request", "Scheduler",
            "PagedKVCache", "BlockAllocator", "NULL_BLOCK",
            "env_block_size", "env_max_batch", "env_pool_bytes",
            "env_max_queue", "env_deadline_s", "env_replicas",
-           "env_heartbeat_s"]
+           "env_heartbeat_s", "Autoscaler", "maybe_autoscale"]

@@ -151,6 +151,12 @@ class Router:
         self._records = {}         # req_id -> _Record
         self._stop = False
         self._draining = False
+        # kept for elastic scale-up (ISSUE 20): spawn_replica()
+        # rebuilds an engine from the SAME recipe, so an autoscaled
+        # replica is configured identically to the boot fleet
+        self._model = model
+        self._engine_kwargs = dict(engine_kwargs)
+        self._incident_export = bool(incident_export)
         # exports that could not be replaced anywhere (no healthy
         # replica left) — retained, never silently dropped
         self.orphan_exports = []
@@ -195,6 +201,12 @@ class Router:
                 name=f"serve-replica-{rep.idx}", daemon=True)
             rep.thread = t
             t.start()
+        # observability -> capacity loop (ISSUE 20): None unless
+        # PADDLE_SERVE_AUTOSCALE arms it — zero listeners, zero
+        # serve/autoscale/* stats, bit-identical serving otherwise
+        from . import autoscaler as _autoscaler
+
+        self.autoscaler = _autoscaler.maybe_autoscale(self)
 
     # -- worker loop -------------------------------------------------
     def _replica_loop(self, rep):
@@ -378,41 +390,133 @@ class Router:
             _flight.record("serve_failover", replica=rep.idx,
                            reason=str(reason)[:200],
                            exported=len(exports))
-            for i, exp in enumerate(exports):
-                rec = self._records.get(exp["req_id"])
-                excluded = []
-                while True:
-                    try:
-                        target = self._pick_replica(exclude=excluded)
-                    except RuntimeError:
-                        # nowhere to replay: retain, never drop
-                        self.orphan_exports.extend(exports[i:])
-                        raise
-                    try:
-                        was_idle = not \
-                            target.engine.scheduler.has_work()
-                        rid = target.engine.import_request(
-                            exp,
-                            on_token=rec.on_token if rec else None,
-                            force=True)
-                    except EngineOverloaded:
-                        # target got fenced between the pick and
-                        # the import (concurrent incident hook) —
-                        # try the next survivor
-                        excluded.append(target)
-                        continue
-                    break
-                if rec is not None:
-                    rec.replica = target.idx
-                    rec.req = target.engine.get_request(rid)
-                if _trace._armed:
-                    _trace.note(target.engine.get_request(rid),
-                                "failover", from_replica=rep.idx,
-                                to_replica=target.idx,
-                                reason=str(reason)[:80])
-                if was_idle:     # idle->work only, as in submit()
-                    target.engine.heartbeat = time.monotonic()
-                target.work.set()
+            self._replay(exports, rep, reason)
+
+    def _replay(self, exports, rep, reason):
+        """Replay exported requests on the survivors,
+        token-identically (caller holds the router lock; `rep` is
+        the retired source replica). Shared by crash failover and
+        planned scale-down — the SAME placement loop, so a drained
+        replica's requests land exactly where a crashed one's would.
+        Exports that cannot be placed are retained in
+        `orphan_exports`, never dropped."""
+        for i, exp in enumerate(exports):
+            rec = self._records.get(exp["req_id"])
+            excluded = []
+            while True:
+                try:
+                    target = self._pick_replica(exclude=excluded)
+                except RuntimeError:
+                    # nowhere to replay: retain, never drop
+                    self.orphan_exports.extend(exports[i:])
+                    raise
+                try:
+                    was_idle = not \
+                        target.engine.scheduler.has_work()
+                    rid = target.engine.import_request(
+                        exp,
+                        on_token=rec.on_token if rec else None,
+                        force=True)
+                except EngineOverloaded:
+                    # target got fenced between the pick and
+                    # the import (concurrent incident hook) —
+                    # try the next survivor
+                    excluded.append(target)
+                    continue
+                break
+            if rec is not None:
+                rec.replica = target.idx
+                rec.req = target.engine.get_request(rid)
+            if _trace._armed:
+                _trace.note(target.engine.get_request(rid),
+                            "failover", from_replica=rep.idx,
+                            to_replica=target.idx,
+                            reason=str(reason)[:80])
+            if was_idle:     # idle->work only, as in submit()
+                target.engine.heartbeat = time.monotonic()
+            target.work.set()
+
+    # -- elastic capacity (ISSUE 20) ---------------------------------
+    def spawn_replica(self):
+        """Scale UP by one replica; returns its index, or None when
+        the router is stopping/draining. The engine builds OUTSIDE
+        the router lock — boot is a warm start off the
+        `serve_decode:<Model>` persistent-cache entry the first
+        replica published, but even a cache load must not stall
+        submit/health traffic — then joins the fleet under the lock
+        with the same spec negotiation the boot fleet ran."""
+        if self._stop or self._draining:
+            return None
+        eng = LLMEngine(self._model, **self._engine_kwargs)
+        if self._incident_export:
+            eng.arm_incident_export()
+        with self._lock:
+            if self._stop or self._draining:
+                return None
+            idx = len(self._replicas)
+            rep = _Replica(idx, eng)
+            # fleet spec config only ever negotiates DOWN (ISSUE
+            # 19): a newcomer clamped below the fleet drags the
+            # fleet to its window; a roomier one adopts the fleet's
+            if eng.spec_k < self.spec_k:
+                _flight.record("serve_spec_negotiate",
+                               spec_ks=[self.spec_k, eng.spec_k],
+                               negotiated=eng.spec_k,
+                               scope="spawn")
+                self.spec_k = eng.spec_k
+            if self.spec_k > 1:
+                _cmon.stat_set("serve/spec/fleet_k", self.spec_k)
+            self.prefix_cache = (self.prefix_cache
+                                 and bool(eng.prefix_cache))
+            self._replicas.append(rep)
+            _cmon.stat_set(f"serve/replica/{idx}/healthy", 1)
+            t = threading.Thread(
+                target=self._replica_loop, args=(rep,),
+                name=f"serve-replica-{idx}", daemon=True)
+            rep.thread = t
+            t.start()
+        _flight.record("serve_scale_up", replica=idx,
+                       replicas=len(self._replicas))
+        return idx
+
+    def retire_replica(self, idx=None):
+        """Scale DOWN by one replica (default: the newest live one)
+        via the token-exact export path: fence, export its in-flight
+        requests, replay them on the survivors — callers see
+        identical tokens, just from elsewhere. Refuses to retire the
+        last healthy replica. Returns the retired index."""
+        with self._lock:
+            live = self._live()
+            if len(live) <= 1:
+                raise RuntimeError(
+                    "refusing to retire the last healthy replica")
+            rep = (max(live, key=lambda r: r.idx) if idx is None
+                   else self._replicas[idx])
+            if rep not in live:
+                raise RuntimeError(
+                    f"replica {rep.idx} is not live — nothing to "
+                    "retire")
+            rep.healthy = False
+            _cmon.stat_set(f"serve/replica/{rep.idx}/healthy", 0)
+            with _flight.in_flight("serve_scale_down",
+                                   f"replica-{rep.idx}"):
+                # same fence-then-bounded-sweep as _failover: the
+                # worker parks after its current step, a wedged one
+                # is worked around (fenced zombies no-op)
+                eng = rep.engine
+                eng._fenced = True
+                with _step_guard(rep, 1.25):
+                    exports = eng.emergency_exports or []
+                    eng.emergency_exports = None
+                    exports = exports + eng.export_requests(
+                        fence=True)
+                rep.dead = True
+                rep.work.set()      # unpark the worker so it exits
+                _flight.record("serve_scale_down", replica=rep.idx,
+                               exported=len(exports),
+                               replicas=len(self._live()))
+                self._replay(exports, rep, "scale_down")
+            return rep.idx
 
     # -- completion --------------------------------------------------
     def wait(self, ids=None, timeout_s=None):
@@ -540,6 +644,8 @@ class Router:
         """Stop worker threads, disarm incident hooks. Engines stay
         readable (results, audits) but nothing steps anymore."""
         self._stop = True
+        if self.autoscaler is not None:
+            self.autoscaler.detach()
         for rep in self._replicas:
             rep.work.set()
         for rep in self._replicas:

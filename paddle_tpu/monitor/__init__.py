@@ -55,6 +55,15 @@ tooling around them):
     on-demand capture; `python -m paddle_tpu.monitor scrape` pulls N
     ranks' pages into the fleet straggler report. See server.py and
     the README "Live introspection" section.
+
+  * alerts (submodule) — the ACTING side (ISSUE 20): declarative SLO
+    alert rules (threshold / windowed-quantile / rate / burn_rate /
+    fraction / absence) over the live registry, armed by the
+    PADDLE_ALERTS spec, evaluated on a bounded cadence into
+    pending→firing→resolved state with alerts/* counters, flight
+    events, the /alertz page, fleet-wide rollup, and the serving
+    Autoscaler as first closed-loop consumer. See alerts.py and the
+    README "Alerting & autoscaling" section.
 """
 from __future__ import annotations
 
@@ -77,6 +86,7 @@ from . import sanitize  # noqa: E402 — runtime sanitizer core (ISSUE 10)
 from . import trace  # noqa: E402 — per-request serving traces (ISSUE 15)
 from . import fleet  # noqa: E402 — fleet aggregation + stragglers
 from . import server  # noqa: E402 — live introspection plane (ISSUE 18)
+from . import alerts  # noqa: E402 — SLO alert rules + burn rate (ISSUE 20)
 from .server import (  # noqa: F401 — the pull-side lifecycle surface
     serve, get_server, stop_server, maybe_auto_serve,
 )
@@ -90,7 +100,7 @@ __all__ = [
     "get_exporter", "telemetry_snapshot", "fleet_snapshot",
     "prometheus_text", "serve", "get_server", "stop_server",
     "maybe_auto_serve", "flight",
-    "memory", "perf", "chaos", "trace", "fleet", "server",
+    "memory", "perf", "chaos", "trace", "fleet", "server", "alerts",
 ]
 
 

@@ -415,6 +415,73 @@ def cmd_chaos(args):
 
 
 # ---------------------------------------------------------------------------
+# alerts (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def cmd_alerts(args):
+    from . import alerts as alerts_mod
+
+    spec = args.spec if args.spec is not None \
+        else os.environ.get("PADDLE_ALERTS", "")
+    parsed = None
+    if spec:
+        try:
+            parsed = alerts_mod.parse_spec(spec)
+        except ValueError as e:
+            print(f"error: invalid alert spec: {e}", file=sys.stderr)
+            return 2
+    if args.json:
+        json.dump({"kinds": alerts_mod.KINDS,
+                   "params": alerts_mod.PARAMS,
+                   "spec": spec or None,
+                   "rules": [r.describe() for r in parsed or []],
+                   "default_pack": [r.describe()
+                                    for r in
+                                    alerts_mod.default_rules()],
+                   "live": alerts_mod.describe()},
+                  sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0
+    out = ["alert rule kinds (PADDLE_ALERTS = "
+           "\"metric:kind[:param=value]*[;...]\"; bare `serving` "
+           "arms the default pack):", ""]
+    w = max(len(k) for k in alerts_mod.KINDS)
+    for k in sorted(alerts_mod.KINDS):
+        out.append(f"  {k:<{w}s}  {alerts_mod.KINDS[k]}")
+    out.append("")
+    out.append("params:")
+    w = max(len(p) for p in alerts_mod.PARAMS)
+    for p in sorted(alerts_mod.PARAMS):
+        out.append(f"  {p:<{w}s}  {alerts_mod.PARAMS[p]}")
+    out.append("")
+    out.append("default serving pack (PADDLE_ALERTS=serving):")
+    pack = alerts_mod.default_rules()
+    w = max(len(r.name) for r in pack)
+    for r in pack:
+        d = r.describe()
+        extra = " ".join(
+            f"{k}={v}" for k, v in d.items()
+            if k not in ("name", "kind", "metric", "state", "value",
+                         "streak", "fired") and v is not None)
+        out.append(f"  {r.name:<{w}s}  {r.kind}  {r.metric}  "
+                   f"{extra}")
+    if parsed is not None:
+        out.append("")
+        out.append(f"spec OK — {len(parsed)} rule(s): {spec}")
+        for r in parsed:
+            d = r.describe()
+            extra = " ".join(
+                f"{k}={v}" for k, v in d.items()
+                if k not in ("name", "kind", "metric", "state",
+                             "value", "streak", "fired")
+                and v is not None)
+            out.append(f"  {d['name']}  {d['kind']}  {d['metric']}  "
+                       f"{extra}")
+    print("\n".join(out))
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # merge-traces
 # ---------------------------------------------------------------------------
 
@@ -709,6 +776,18 @@ def _fleet_lines(view, show_all=False, noun="artifact"):
     else:
         out.append("no step/count in any artifact — straggler "
                    "detection needs step telemetry")
+    al = view.get("alerts") or {}
+    if al.get("armed_ranks"):
+        out.append("")
+        state = "FIRING" if al.get("any_firing") else "quiet"
+        out.append(f"alerts ({state}; armed on ranks "
+                   f"{al['armed_ranks']}):")
+        for name in sorted(al.get("rules") or {}):
+            slot = al["rules"][name]
+            bits = [
+                f"{st}=r{','.join(str(r) for r in slot[st])}"
+                for st in ("firing", "resolved", "ok") if slot[st]]
+            out.append(f"  {name}  " + "  ".join(bits))
     return out
 
 
@@ -839,6 +918,18 @@ def main(argv=None):
                      help="emit sites/faults/params + parsed rules as "
                           "JSON")
     pch.set_defaults(fn=cmd_chaos)
+
+    pal = sub.add_parser(
+        "alerts",
+        help="list alert rule kinds/params + the default serving "
+             "pack and validate a PADDLE_ALERTS spec")
+    pal.add_argument("spec", nargs="?",
+                     help="spec to validate (default: "
+                          "$PADDLE_ALERTS)")
+    pal.add_argument("--json", action="store_true",
+                     help="emit kinds/params/default pack + parsed "
+                          "rules + live engine state as JSON")
+    pal.set_defaults(fn=cmd_alerts)
 
     ptr = sub.add_parser(
         "trace",

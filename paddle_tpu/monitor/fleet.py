@@ -45,7 +45,8 @@ from . import flight as _flight
 __all__ = ["is_gauge", "merge_hists", "merge_records",
            "straggler_report", "load_spool", "load_records",
            "fleet_view", "fleet_snapshot", "top_spans",
-           "slowest_program", "scrape_records", "scrape_view"]
+           "slowest_program", "scrape_records", "scrape_view",
+           "alert_rollup"]
 
 
 # -- counter-vs-gauge classification ---------------------------------------
@@ -58,7 +59,7 @@ __all__ = ["is_gauge", "merge_hists", "merge_records",
 _GAUGE_PREFIXES = ("mem/", "step/mem/", "step/attrib/",
                    "flight/events", "flight/ring/",
                    "serve/kv_blocks/", "chaos/", "sanitize/",
-                   "perf/")
+                   "perf/", "alerts/")
 _GAUGE_SUFFIXES = ("/queue_depth", "/throughput", "/healthy",
                    "/armed", "/steps_per_dispatch")
 _GAUGE_SUBSTR = ("/last_", "/lr_e9", "last_loss", "last_time")
@@ -313,7 +314,67 @@ def fleet_view(paths, threshold=None):
     view["stragglers"] = straggler_report(records,
                                           threshold=threshold)
     view["sources"] = [r.get("source") for r in records]
+    view["alerts"] = alert_rollup(records)
     return view
+
+
+# -- fleet-wide alert rollup (ISSUE 20) ------------------------------------
+
+def alert_rollup(records):
+    """Any-rank-firing rollup of per-rank alert states.
+
+    Prefers the scraped /alertz payload (rec["alerts"], exact rule
+    states); falls back to inferring from the alerts/* stats every
+    armed rank publishes: `alerts/<name>/firing` 1 -> firing, 0 with
+    transitions>0 -> resolved, 0 with none -> ok. A rank with no
+    alerts/* stats at all simply never armed the engine — absent
+    from `armed_ranks`, not an error (a fleet mixing armed frontends
+    with unarmed trainers is normal).
+
+    Returns {"any_firing", "armed_ranks",
+             "rules": {name: {"firing": [ranks], "resolved": [...],
+                              "ok": [...]}}}.
+    """
+    armed_ranks = []
+    rules = {}
+
+    def _mark(name, state, rank):
+        slot = rules.setdefault(
+            name, {"firing": [], "resolved": [], "ok": []})
+        if rank not in slot[state]:
+            slot[state].append(rank)
+
+    for rec in records:
+        rank = int(rec.get("rank", 0))
+        payload = rec.get("alerts")
+        if isinstance(payload, dict) and payload.get("armed"):
+            armed_ranks.append(rank)
+            for r in payload.get("rules") or []:
+                st = r.get("state")
+                _mark(r.get("name", "?"),
+                      "firing" if st == "firing" else
+                      "resolved" if st == "resolved" else "ok",
+                      rank)
+            continue
+        stats = rec.get("stats") or {}
+        names = {k.split("/")[1] for k in stats
+                 if k.startswith("alerts/") and k.count("/") == 2}
+        if not names:
+            continue
+        armed_ranks.append(rank)
+        for name in names:
+            if stats.get(f"alerts/{name}/firing", 0):
+                _mark(name, "firing", rank)
+            elif stats.get(f"alerts/{name}/transitions", 0):
+                _mark(name, "resolved", rank)
+            else:
+                _mark(name, "ok", rank)
+    for slot in rules.values():
+        for ranks in slot.values():
+            ranks.sort()
+    return {"any_firing": any(s["firing"] for s in rules.values()),
+            "armed_ranks": sorted(armed_ranks),
+            "rules": rules}
 
 
 # -- live scraping (HTTP pull from monitor.server) -------------------------
@@ -356,6 +417,12 @@ def scrape_records(targets, timeout=5.0, with_flight=True):
                 rec["status"] = _scrape_json(base, "/statusz", timeout)
             except Exception:
                 pass
+            try:  # exact rule states beat the stats-inferred rollup
+                al = _scrape_json(base, "/alertz", timeout)
+                if isinstance(al, dict) and al.get("armed"):
+                    rec["alerts"] = al
+            except Exception:
+                pass
             if with_flight:
                 try:
                     fl = _scrape_json(base, "/flightz", timeout)
@@ -380,6 +447,7 @@ def scrape_view(records, threshold=None):
     view["stragglers"] = straggler_report(records,
                                           threshold=threshold)
     view["sources"] = [r.get("source") for r in records]
+    view["alerts"] = alert_rollup(records)
     return view
 
 

@@ -414,6 +414,18 @@ def _server_section():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _alerts_section():
+    """monitor.alerts.describe() with a total fallback — a dump taken
+    before the alerts module finished importing (env autostart runs
+    at import time) must still write."""
+    try:
+        from . import alerts as _alerts_mod
+
+        return _alerts_mod.describe()
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def write_dump(reason, extra=None, path=None, full_memory=None):
     """Write one self-contained JSON forensics bundle and return its
     path. Schema (DUMP_SCHEMA = "paddle_tpu.flight/1"):
@@ -469,6 +481,11 @@ def write_dump(reason, extra=None, path=None, full_memory=None):
         # was armed and on which port — a post-mortem can tell
         # whether /profilez etc. were scrapeable before the crash
         "server": _server_section(),
+        # SLO alert engine (ISSUE 20): which rules were armed and
+        # their pending/firing/resolved states at dump time — a
+        # post-mortem can tell whether the SLOs were already burning
+        # before the crash
+        "alerts": _alerts_section(),
     }
     try:
         from . import telemetry_snapshot

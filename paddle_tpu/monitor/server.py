@@ -95,6 +95,9 @@ ROUTES = (
     ("/profilez", "on-demand capture window (?duration_ms=N, "
                   "?profiler=0 for flight-only); returns the bundle",
      "always"),
+    ("/alertz", "alert engine state: spec, cadence, every rule with "
+                "its live pending/firing/resolved state + last value",
+     "PADDLE_ALERTS"),
 )
 
 PROFILEZ_SCHEMA = "paddle_tpu.profilez/1"
@@ -415,6 +418,16 @@ class _Handler(BaseHTTPRequestHandler):
                     code=409)
             else:
                 self._send_json(bundle)
+        elif path == "/alertz":
+            # lazy import (alerts imports this module's sibling
+            # surfaces); force the flight-ring stat sync first
+            # (ISSUE 20 satellite 1) so a scrape sees the same
+            # registry truth the evaluator does
+            from . import alerts as _alerts
+            _flight.sync_stats()
+            doc = dict(_alerts.describe())
+            doc["rank"] = _flight._rank()
+            self._send_json(doc)
         elif path == "/":
             index = {p: desc for p, desc, _ in ROUTES}
             self._send_json({"paddle_tpu": True, "routes": index})

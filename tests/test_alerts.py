@@ -1,0 +1,809 @@
+"""ISSUE 20: SLO alerting & control plane.
+
+Four rings:
+
+  * Histogram windows — `delta_since` windowed deltas + the
+    quantile sentinel edges alert evaluation hits between traffic
+    waves (empty window, all-underflow, single bucket, counter
+    reset, boundary mismatch).
+  * Rule engine — spec grammar on the chaos/sanitize family, every
+    rule kind's state machine via deterministic evaluate_once()
+    ticks, the satellite-1 regression (a just-recorded flight gauge
+    is visible to the next tick), /alertz, the `monitor alerts`
+    CLI on the exit-2 contract.
+  * Fleet rollup — `monitor fleet`/`scrape` any-rank-firing rollup
+    over 3 synthetic rank spools (firing / resolved / never-armed),
+    text + --json, partial-fleet exit-1 preserved.
+  * Closed loop — the acceptance gate: chaos latency storm on a
+    1-replica Router fires the TTFT alert, the Autoscaler spawns a
+    second replica, the alert resolves and drains it back, tokens
+    identical to the fault-free run, zero KV blocks leak fleet-wide;
+    disarmed runs are thread-free and alerts/*-counter-clean
+    (subprocess).
+"""
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.inference.serving import (Autoscaler, LLMEngine,
+                                          Router, SamplingParams)
+from paddle_tpu.monitor import alerts, chaos, flight
+from paddle_tpu.monitor import server as mserver
+from paddle_tpu.monitor.cli import main as cli_main
+from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_TOKENS = 6
+PROMPT_LENS = (3, 9, 5, 12, 7, 4)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    alerts.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, ffn_hidden=128, max_seq_len=64,
+                    dropout=0.0, use_flash_attention=False,
+                    initializer_range=0.35)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(3)
+    return [list(rng.randint(1, 128, n)) for n in PROMPT_LENS]
+
+
+@pytest.fixture(scope="module")
+def want(model, prompts):
+    eng = LLMEngine(model, max_batch=4, block_size=8, num_blocks=32)
+    outs = eng.generate(
+        prompts, sampling=SamplingParams(max_new_tokens=N_TOKENS))
+    assert eng.check_drained() == {}
+    return outs
+
+
+def sp(**kw):
+    kw.setdefault("max_new_tokens", N_TOKENS)
+    return SamplingParams(**kw)
+
+
+def assert_no_leaks(router):
+    from paddle_tpu.analysis.serving import audit_block_accounting
+
+    assert router.check_drained() == {}
+    for rep in router._replicas:
+        eng = rep.engine
+        live = [r.req_id for r in eng._requests.values()
+                if not r.finished]
+        rep_ = audit_block_accounting(eng.cache.allocator, live)
+        assert rep_.findings == [], \
+            [f.format() for f in rep_.findings]
+
+
+# ---------------------------------------------------------------------------
+# ring (a): Histogram.delta_since + quantile sentinels (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestDeltaSince:
+    def test_windowed_delta_isolates_recent_observations(self):
+        h = cmon.Histogram()
+        for _ in range(100):
+            h.observe(10.0)
+        snap = h.snapshot()
+        for _ in range(10):
+            h.observe(50_000.0)
+        delta = h.delta_since(snap)
+        assert delta["count"] == 10
+        # cumulative p99 is still dominated by the 100 fast obs;
+        # the WINDOW sees only the storm
+        assert cmon.snapshot_quantile(h.snapshot(), 0.5) < 100
+        assert cmon.snapshot_quantile(delta, 0.5) > 10_000
+        assert delta["sum"] == pytest.approx(500_000.0)
+
+    def test_none_snapshot_is_full_view(self):
+        h = cmon.Histogram()
+        h.observe(7.0)
+        d = h.delta_since(None)
+        assert d["count"] == 1
+        assert d["sum"] == pytest.approx(7.0)
+
+    def test_boundary_mismatch_raises(self):
+        h = cmon.Histogram()
+        other = cmon.Histogram(per_decade=10)
+        h.observe(1.0)
+        with pytest.raises(ValueError, match="boundaries"):
+            h.delta_since(other.snapshot())
+
+    def test_counter_reset_falls_back_to_cumulative(self):
+        old = cmon.Histogram()
+        for _ in range(5):
+            old.observe(100.0)
+        snap = old.snapshot()
+        fresh = cmon.Histogram()   # "process restarted"
+        fresh.observe(200.0)
+        d = fresh.delta_since(snap)
+        assert d["count"] == 1     # current state IS the window
+        assert d["sum"] == pytest.approx(200.0)
+
+    def test_empty_window_quantile_sentinel(self):
+        h = cmon.Histogram()
+        h.observe(100.0)
+        snap = h.snapshot()
+        delta = h.delta_since(snap)          # nothing since
+        assert delta["count"] == 0
+        # sentinel, not a raise and not a fake value
+        assert cmon.snapshot_quantile(delta, 0.99, empty=None) is None
+        # back-compat default stays numeric (CLI renders with :.1f)
+        assert cmon.snapshot_quantile(delta, 0.99) == 0.0
+        assert cmon.Histogram().quantile(0.5) == 0.0
+        assert cmon.Histogram().quantile(0.5, empty=None) is None
+
+    def test_all_underflow_window_returns_sentinel_not_lo(self):
+        h = cmon.Histogram()     # lo=1.0: v<=1.0 is underflow
+        h.observe(500.0)
+        snap = h.snapshot()
+        for _ in range(3):
+            h.observe(0.25)
+        delta = h.delta_since(snap)
+        assert delta["count"] == 3
+        q = cmon.snapshot_quantile(delta, 0.99, empty=None)
+        # delta windows have no min/max: an all-underflow window
+        # must NOT report lo (1.0) as a fake p99
+        assert q is None
+
+    def test_live_underflow_keeps_exact_min(self):
+        h = cmon.Histogram()
+        h.observe(0.25)
+        assert h.quantile(0.99) == pytest.approx(0.25)
+
+    def test_single_bucket_window(self):
+        h = cmon.Histogram()
+        snap = h.snapshot()
+        for _ in range(5):
+            h.observe(100.0)
+        delta = h.delta_since(snap)
+        q = cmon.snapshot_quantile(delta, 0.99, empty=None)
+        # inside the log bucket that holds 100 (no exact min/max in
+        # a delta — bucket-edge resolution is the contract)
+        assert q is not None and 50.0 < q < 200.0
+
+    def test_overflow_window_reports_finite_lower_bound(self):
+        h = cmon.Histogram(decades=3)       # top edge 1e3
+        snap = h.snapshot()
+        h.observe(1e9)
+        delta = h.delta_since(snap)
+        q = cmon.snapshot_quantile(delta, 0.99, empty=None)
+        assert q is not None and math.isfinite(q)
+        assert q >= 1e3      # honest lower bound: still trips alerts
+
+
+# ---------------------------------------------------------------------------
+# ring (b): spec grammar + rule state machines
+# ---------------------------------------------------------------------------
+
+class TestSpec:
+    def test_default_pack_words(self):
+        for word in ("serving", "default", "all", "1", "on", "true"):
+            rules = alerts.parse_spec(word)
+            assert {r.name for r in rules} == {
+                "ttft_p99", "itl_p99", "shed_rate", "queue_depth",
+                "kv_free_frac", "replica_unhealthy"}
+
+    def test_explicit_rules(self):
+        rules = alerts.parse_spec(
+            "serve/queue_depth:threshold:gt=10:for=2;"
+            "serve/hist/ttft_us:quantile:q=0.95:gt=1000:name=t95")
+        assert len(rules) == 2
+        assert rules[0].for_ticks == 2
+        assert rules[1].q == 0.95 and rules[1].name == "t95"
+
+    @pytest.mark.parametrize("bad", [
+        "nokind",                              # missing kind
+        "m:notakind:gt=1",                     # unknown kind
+        "m:threshold",                         # no bound
+        "m:threshold:gt=1:lt=2",               # two bounds
+        "m:threshold:gt=1:bogus=3",            # unknown param
+        "m:threshold:gt=oops",                 # non-numeric
+        "m:quantile:q=1.5:gt=1",               # q out of range
+        "m:burn_rate:gt=1:total=t",            # burn takes no op
+        "m:burn_rate",                         # burn needs total
+        "m:fraction:lt=0.1",                   # fraction needs of
+        "m/*:quantile:gt=1",                   # glob non-threshold
+        "m:threshold:gt=1:name=ba d",          # bad rule name
+    ])
+    def test_invalid_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            alerts.parse_spec(bad)
+
+    def test_duplicate_names_rejected_at_configure(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            alerts.configure(
+                spec="a/b:threshold:gt=1:name=x;"
+                     "c/d:threshold:gt=1:name=x", start=False)
+        assert not alerts.armed()
+
+    def test_configure_publishes_armed_shape(self):
+        alerts.configure(spec="a/b:threshold:gt=1:name=shape",
+                         start=False)
+        snap = cmon.registry.snapshot()
+        assert snap["alerts/armed"] == 1
+        assert snap["alerts/shape/firing"] == 0
+        assert snap["alerts/shape/transitions"] == 0
+        alerts.disarm()
+        assert cmon.registry.snapshot()["alerts/armed"] == 0
+
+
+class TestStateMachine:
+    def test_threshold_for_clear_hysteresis(self):
+        r = alerts.AlertRule("t/depth", "threshold", gt=10,
+                             name="depth", **{"for": 2, "clear": 2})
+        alerts.configure(rules=[r], start=False)
+        cmon.stat_set("t/depth", 5)
+        alerts.evaluate_once(now=1.0)
+        assert r.state == "ok"
+        cmon.stat_set("t/depth", 99)
+        alerts.evaluate_once(now=2.0)
+        assert r.state == "pending"          # for=2: one tick isn't
+        evs = alerts.evaluate_once(now=3.0)
+        assert r.state == "firing"
+        assert [(ru.name, ev) for ru, ev, _ in evs] == \
+            [("depth", "fire")]
+        cmon.stat_set("t/depth", 0)
+        alerts.evaluate_once(now=4.0)
+        assert r.state == "firing"           # clear=2: one clean tick
+        evs = alerts.evaluate_once(now=5.0)
+        assert r.state == "resolved"
+        assert [(ru.name, ev) for ru, ev, _ in evs] == \
+            [("depth", "resolve")]
+        snap = cmon.registry.snapshot()
+        assert snap["alerts/depth/firing"] == 0
+        assert snap["alerts/depth/transitions"] == 2
+
+    def test_threshold_glob_any_match(self):
+        cmon.stat_set("g/replica/0/healthy", 1)
+        cmon.stat_set("g/replica/1/healthy", 0)
+        r = alerts.AlertRule("g/replica/*/healthy", "threshold",
+                             lt=1, name="unhealthy")
+        alerts.configure(rules=[r], start=False)
+        alerts.evaluate_once(now=1.0)
+        assert r.state == "firing" and r.value == 0
+
+    def test_rate_and_reset_rebase(self):
+        r = alerts.AlertRule("ra/errs", "rate", gt=5.0, window=10,
+                             name="er", clear=1)
+        alerts.configure(rules=[r], start=False)
+        cmon.stat_set("ra/errs", 0)
+        alerts.evaluate_once(now=0.0)
+        assert r.value is None               # window still filling
+        cmon.stat_set("ra/errs", 100)
+        alerts.evaluate_once(now=10.0)
+        assert r.state == "firing"
+        assert r.value == pytest.approx(10.0)
+        cmon.stat_set("ra/errs", 2)          # counter reset
+        alerts.evaluate_once(now=20.0)
+        assert r.value is None               # rebased, not negative
+        alerts.evaluate_once(now=21.0)
+        assert r.state == "resolved"
+
+    def test_burn_rate_needs_both_windows(self):
+        r = alerts.AlertRule("b/errs", "burn_rate", total="b/reqs",
+                             budget=0.1, factor=2.0, window=10,
+                             long=30, name="burn")
+        alerts.configure(rules=[r], start=False)
+        cmon.stat_set("b/errs", 0)
+        cmon.stat_set("b/reqs", 0)
+        alerts.evaluate_once(now=0.0)
+        assert r.state == "ok"
+        # 4 errors / 20 requests = 20% of traffic vs a 10% budget
+        # -> burn 2.0x in BOTH windows
+        cmon.stat_set("b/errs", 4)
+        cmon.stat_set("b/reqs", 20)
+        alerts.evaluate_once(now=10.0)
+        assert r.state == "firing"
+        assert r.value == pytest.approx(2.0)
+        # traffic continues clean -> short window burn collapses
+        cmon.stat_set("b/errs", 4)
+        cmon.stat_set("b/reqs", 220)
+        alerts.evaluate_once(now=25.0)
+        alerts.evaluate_once(now=26.0)
+        assert r.state == "resolved"
+
+    def test_fraction(self):
+        r = alerts.AlertRule("f/free", "fraction", of="f/used",
+                             lt=0.2, name="freefrac")
+        alerts.configure(rules=[r], start=False)
+        cmon.stat_set("f/free", 50)
+        cmon.stat_set("f/used", 50)
+        alerts.evaluate_once(now=1.0)
+        assert r.state == "ok" and r.value == pytest.approx(0.5)
+        cmon.stat_set("f/free", 5)
+        cmon.stat_set("f/used", 95)
+        alerts.evaluate_once(now=2.0)
+        assert r.state == "firing"
+
+    def test_absence_fires_until_series_appears(self):
+        r = alerts.AlertRule("ab/never", "absence", name="gone",
+                             clear=1)
+        alerts.configure(rules=[r], start=False)
+        alerts.evaluate_once(now=1.0)
+        assert r.state == "firing"
+        cmon.stat_set("ab/never", 1)
+        alerts.evaluate_once(now=2.0)
+        assert r.state == "resolved"
+
+    def test_absence_sees_histograms(self):
+        cmon.hist_observe("ab/hist_series", 1.0)
+        r = alerts.AlertRule("ab/hist_series", "absence", name="ha")
+        alerts.configure(rules=[r], start=False)
+        alerts.evaluate_once(now=1.0)
+        assert r.state == "ok"
+
+    def test_quantile_windowed_storm_then_recovery(self):
+        h = cmon.hist_get("qa/lat_us")
+        for _ in range(200):
+            h.observe(50.0)
+        r = alerts.AlertRule("qa/lat_us", "quantile", q=0.9,
+                             gt=10_000.0, name="lat", clear=1)
+        alerts.configure(rules=[r], start=False)
+        alerts.evaluate_once(now=1.0)       # baseline the window
+        assert r.state == "ok"
+        for _ in range(20):
+            h.observe(90_000.0)
+        alerts.evaluate_once(now=2.0)
+        # cumulative p90 is still ~50 (200 fast vs 20 slow) — only
+        # the windowed delta can see the storm
+        assert r.state == "firing"
+        assert r.value == pytest.approx(90_000.0, rel=0.2)
+        for _ in range(50):
+            h.observe(60.0)
+        alerts.evaluate_once(now=3.0)
+        assert r.state == "resolved"
+
+    def test_listener_fanout_and_errors_counted(self):
+        got = []
+        boom = lambda *a: (_ for _ in ()).throw(RuntimeError("x"))
+        alerts.add_listener(boom)
+        alerts.add_listener(lambda ru, ev, v: got.append((ru.name,
+                                                          ev)))
+        try:
+            r = alerts.AlertRule("li/x", "threshold", gt=1,
+                                 name="li")
+            alerts.configure(rules=[r], start=False)
+            cmon.stat_set("li/x", 5)
+            alerts.evaluate_once(now=1.0)
+            assert got == [("li", "fire")]
+            assert cmon.registry.snapshot()[
+                "alerts/listener_errors"] >= 1
+        finally:
+            alerts.remove_listener(boom)
+            alerts._listeners.clear()
+
+    def test_evaluator_thread_lifecycle(self):
+        alerts.configure(spec="th/x:threshold:gt=1:name=th",
+                         start=True, interval_s=0.05)
+        names = [t.name for t in threading.enumerate()]
+        assert "paddle-alert-evaluator" in names
+        alerts.disarm()
+        names = [t.name for t in threading.enumerate()]
+        assert "paddle-alert-evaluator" not in names
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: flight-ring gauge staleness
+# ---------------------------------------------------------------------------
+
+class TestFlightGaugeSync:
+    def test_just_recorded_gauge_visible_to_next_tick(self):
+        flight.record("alerts_test_seed")
+        true_before = flight.recorder.stats()["events"]
+        r = alerts.AlertRule("flight/events", "threshold",
+                             ge=true_before + 1, name="flfresh")
+        alerts.configure(rules=[r], start=False)
+        flight.record("alerts_test_marker")
+        marker_seq = flight.recorder.stats()["events"]
+        alerts.evaluate_once(now=1.0)
+        # the ring amortizes gauge pushes to every 256th record —
+        # the tick must force the sync, see the marker, and fire
+        # (the alert_fire event it then records bumps the live seq
+        # past what the tick saw, so compare against marker time)
+        assert r.value >= marker_seq
+        assert r.value >= true_before + 1
+        assert r.state == "firing"
+        assert cmon.registry.snapshot()["flight/events"] >= \
+            marker_seq
+
+
+# ---------------------------------------------------------------------------
+# /alertz + CLI
+# ---------------------------------------------------------------------------
+
+class TestAlertz:
+    def test_route_registered_and_gated(self):
+        routes = {p: armed for p, _, armed in mserver.ROUTES}
+        assert routes["/alertz"] == "PADDLE_ALERTS"
+
+    def test_alertz_payload(self):
+        alerts.configure(spec="az/x:threshold:gt=1:name=az",
+                         start=False)
+        cmon.stat_set("az/x", 5)
+        srv = mserver.DebugServer(port=0, host="127.0.0.1").start()
+        try:
+            alerts.evaluate_once(now=1.0)
+            with urllib.request.urlopen(srv.url + "/alertz",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            srv.shutdown()
+        assert doc["armed"] is True
+        assert doc["rank"] == 0
+        (rule,) = doc["rules"]
+        assert rule["name"] == "az" and rule["state"] == "firing"
+        assert rule["value"] == 5
+
+    def test_index_lists_alertz(self):
+        srv = mserver.DebugServer(port=0, host="127.0.0.1").start()
+        try:
+            with urllib.request.urlopen(srv.url + "/",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            srv.shutdown()
+        assert "/alertz" in doc["routes"]
+
+
+class TestCLI:
+    def test_lists_kinds_and_default_pack(self, capsys):
+        rc = cli_main(["alerts"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for kind in alerts.KINDS:
+            assert kind in out
+        assert "ttft_p99" in out and "replica_unhealthy" in out
+
+    def test_valid_spec_exits_0(self, capsys):
+        rc = cli_main(["alerts", "serve/shed:rate:gt=0.5"])
+        assert rc == 0
+        assert "spec OK — 1 rule(s)" in capsys.readouterr().out
+
+    def test_invalid_spec_exits_2(self, capsys):
+        rc = cli_main(["alerts", "serve/shed:bogus"])
+        captured = capsys.readouterr()
+        assert rc == 2
+        assert "error: invalid alert spec" in captured.err
+
+    def test_json_view(self, capsys):
+        rc = cli_main(["alerts", "serving", "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc["kinds"]) == set(alerts.KINDS)
+        assert len(doc["default_pack"]) == 6
+        assert len(doc["rules"]) == 6
+        assert doc["live"]["armed"] is False
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: fleet/scrape alert rollup
+# ---------------------------------------------------------------------------
+
+def _alert_spool(rank, firing=None, transitions=0):
+    stats = {"step/count": 5, "step/total_time_us": 5000.0}
+    if firing is not None:
+        stats.update({"alerts/armed": 1,
+                      "alerts/ttft_p99/firing": firing,
+                      "alerts/ttft_p99/transitions": transitions})
+    return {"ts": 1700000000.0 + rank, "rank": rank,
+            "stats": stats, "hists": {}}
+
+
+class TestFleetRollup:
+    def _spools(self, tmp_path):
+        spools = [_alert_spool(0, firing=1, transitions=1),
+                  _alert_spool(1, firing=0, transitions=2),
+                  _alert_spool(2)]           # never armed
+        paths = []
+        for s in spools:
+            p = tmp_path / f"rank{s['rank']}.json"
+            p.write_text(json.dumps(s))
+            paths.append(str(p))
+        return paths
+
+    def test_fleet_text_rollup(self, tmp_path, capsys):
+        rc = cli_main(["fleet"] + self._spools(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "alerts (FIRING; armed on ranks [0, 1])" in out
+        assert "ttft_p99  firing=r0  resolved=r1" in out
+
+    def test_fleet_json_rollup(self, tmp_path, capsys):
+        rc = cli_main(["fleet", "--json"] + self._spools(tmp_path))
+        assert rc == 0
+        view = json.loads(capsys.readouterr().out)
+        roll = view["alerts"]
+        assert roll["any_firing"] is True
+        assert roll["armed_ranks"] == [0, 1]
+        assert roll["rules"]["ttft_p99"]["firing"] == [0]
+        assert roll["rules"]["ttft_p99"]["resolved"] == [1]
+        assert roll["rules"]["ttft_p99"]["ok"] == []
+        # per-rank alert gauges never sum across ranks
+        assert "alerts/ttft_p99/firing" in view["gauges"]
+
+    def test_unarmed_fleet_has_quiet_rollup(self, tmp_path, capsys):
+        p = tmp_path / "rank0.json"
+        p.write_text(json.dumps(_alert_spool(0)))
+        rc = cli_main(["fleet", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "alerts (" not in out         # section only when armed
+
+    def test_scrape_rollup_partial_fleet_exit_1(self, capsys):
+        # one live rank firing, one live rank never armed, one dead
+        # target: rollup lands AND the exit-1 contract is preserved
+        snaps = [_alert_spool(0, firing=1, transitions=1),
+                 _alert_spool(1)]
+        servers = [mserver.DebugServer(
+            port=0, host="127.0.0.1",
+            snapshot_fn=(lambda s=s: s)).start() for s in snaps]
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        try:
+            rc = cli_main(
+                ["scrape", "--no-flight", "--timeout", "2",
+                 f"127.0.0.1:{servers[0].port}",
+                 f"127.0.0.1:{servers[1].port}",
+                 f"127.0.0.1:{dead_port}"])
+        finally:
+            for s in servers:
+                s.shutdown()
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "alerts (FIRING; armed on ranks [0])" in captured.out
+        assert "ttft_p99  firing=r0" in captured.out
+        assert str(dead_port) in captured.err
+
+    def test_scrape_prefers_alertz_payload(self, capsys):
+        # the LOCAL engine is armed but quiet: /alertz (global state)
+        # overrides the spool-stats inference for every scraped rank
+        alerts.configure(
+            spec="sc/x:threshold:gt=1:name=scq", start=False)
+        snap = _alert_spool(0, firing=1, transitions=1)
+        srv = mserver.DebugServer(
+            port=0, host="127.0.0.1",
+            snapshot_fn=(lambda: snap)).start()
+        try:
+            rc = cli_main(["scrape", "--no-flight", "--json",
+                           "--timeout", "2",
+                           f"127.0.0.1:{srv.port}"])
+        finally:
+            srv.shutdown()
+        assert rc == 0
+        view = json.loads(capsys.readouterr().out)
+        roll = view["alerts"]
+        assert roll["armed_ranks"] == [0]
+        # exact rule state from /alertz (ok), not the stats-inferred
+        # "firing" the synthetic spool would suggest
+        assert roll["rules"]["scq"]["ok"] == [0]
+        assert roll["any_firing"] is False
+
+
+# ---------------------------------------------------------------------------
+# ring (d): the closed observability->capacity loop (acceptance gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestClosedLoop:
+    def test_latency_storm_scales_up_then_resolves(
+            self, model, prompts, want):
+        base = cmon.registry.snapshot()
+        b_spawns = base.get("serve/autoscale/spawns", 0)
+        b_drains = base.get("serve/autoscale/drains", 0)
+        router = Router(model, replicas=1, max_batch=4,
+                        block_size=8, num_blocks=32,
+                        heartbeat_timeout_s=60.0)
+        rule = alerts.AlertRule(
+            "serve/hist/ttft_us", "quantile", q=0.5, gt=50_000.0,
+            name="ttft_p99", clear=1)
+        scaler = None
+        try:
+            # warm the router FIRST so compile-time TTFTs can't
+            # masquerade as the storm
+            outs_cold = router.generate(prompts, sampling=sp())
+            assert outs_cold == want
+            alerts.configure(rules=[rule], start=False)
+            # the first tick's window is the FULL cumulative hist
+            # (compile-time TTFTs from earlier fixtures included) —
+            # absorb it, then prove a clean window is quiet, and
+            # only then wire the autoscaler in
+            alerts.evaluate_once()
+            alerts.evaluate_once()
+            outs_quiet = router.generate(prompts, sampling=sp())
+            assert outs_quiet == want
+            alerts.evaluate_once()
+            assert rule.state in ("ok", "resolved")
+            scaler = Autoscaler(router, rule="ttft_p99",
+                                min_replicas=1, max_replicas=2,
+                                cooldown_s=0.0).attach()
+            assert len(router._live()) == 1
+            # chaos latency storm: +100ms at every admission — the
+            # arrival->first-token span (TTFT is prefill-bound; a
+            # decode delay would only show up in ITL) — so every
+            # TTFT in this window blows the 50ms target
+            with chaos.inject("serve_admit", "delay", ms=100):
+                outs_storm = router.generate(prompts, sampling=sp())
+            assert outs_storm == want        # slow, never wrong
+            evs = alerts.evaluate_once()
+            assert [(r.name, ev) for r, ev, _ in evs] == \
+                [("ttft_p99", "fire")]
+            assert rule.state == "firing"
+            assert rule.value > 50_000.0     # the storm, not noise
+            # the autoscaler spawned replica 1 off the same recipe
+            assert len(router._live()) == 2
+            # recovery wave on the scaled fleet absorbs the new
+            # replica's first-dispatch compiles into a window we
+            # never assert on...
+            outs_warm = router.generate(prompts, sampling=sp())
+            assert outs_warm == want
+            alerts.evaluate_once()
+            # ...then a warm wave proves the SLO recovered
+            if rule.state == "firing":
+                outs_clean = router.generate(prompts, sampling=sp())
+                assert outs_clean == want
+                alerts.evaluate_once()
+            assert rule.state == "resolved"
+            # resolve drained back to min_replicas, token-exactly
+            assert len(router._live()) == 1
+            snap = cmon.registry.snapshot()
+            assert snap["serve/autoscale/spawns"] - b_spawns == 1
+            assert snap["serve/autoscale/drains"] - b_drains == 1
+            assert snap["serve/autoscale/replicas"] == 1
+            assert snap["alerts/ttft_p99/transitions"] >= 2
+            assert_no_leaks(router)
+        finally:
+            if scaler is not None:
+                scaler.detach()
+            alerts.disarm()
+            router.shutdown()
+
+    def test_retire_replica_replays_in_flight(self, model, prompts,
+                                              want):
+        """Planned scale-down mid-flood: the retired replica's live
+        requests replay token-identically on the survivor."""
+        router = Router(model, replicas=2, max_batch=4,
+                        block_size=8, num_blocks=32,
+                        heartbeat_timeout_s=60.0)
+        try:
+            ids = [router.submit(p, sampling=sp()) for p in prompts]
+            retired = router.retire_replica()
+            assert retired == 1
+            assert len(router._live()) == 1
+            router.wait(ids, timeout_s=120.0)
+            outs = [router._records[i].req.output_ids for i in ids]
+            assert outs == want
+            for i in ids:
+                router.release(i)
+            assert_no_leaks(router)
+        finally:
+            router.shutdown()
+
+    def test_retire_refuses_last_replica(self, model):
+        router = Router(model, replicas=1, max_batch=2,
+                        block_size=8, num_blocks=32,
+                        heartbeat_timeout_s=60.0)
+        try:
+            with pytest.raises(RuntimeError, match="last healthy"):
+                router.retire_replica()
+        finally:
+            router.shutdown()
+
+    def test_autoscaler_clamps_and_cooldown(self, model):
+        router = Router(model, replicas=1, max_batch=2,
+                        block_size=8, num_blocks=32,
+                        heartbeat_timeout_s=60.0)
+        scaler = Autoscaler(router, min_replicas=1, max_replicas=1,
+                            cooldown_s=3600.0)
+        try:
+            # at max already -> suppressed, no spawn
+            assert scaler.scale_up() is None
+            assert len(router._live()) == 1
+            scaler.max_replicas = 2
+            assert scaler.scale_up() is not None
+            # inside the cooldown -> suppressed
+            assert scaler.scale_down() is None
+            assert len(router._live()) == 2
+        finally:
+            scaler.detach()
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# disarmed provenance (subprocess: a fresh registry proves absence)
+# ---------------------------------------------------------------------------
+
+class TestDisarmedContract:
+    def test_disarmed_is_thread_and_counter_free(self):
+        code = """
+import os, threading
+for k in ("PADDLE_ALERTS", "PADDLE_SERVE_AUTOSCALE"):
+    os.environ.pop(k, None)
+import paddle_tpu.inference.serving as s
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.monitor import alerts
+assert not alerts.armed()
+assert alerts.describe()["rules"] == []
+names = [t.name for t in threading.enumerate()]
+assert "paddle-alert-evaluator" not in names, names
+leaked = {k: v for k, v in cmon.registry.snapshot().items()
+          if k.startswith(("alerts/", "serve/autoscale/"))}
+assert not leaked, leaked
+print("CLEAN")
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PADDLE_ALERTS", None)
+        env.pop("PADDLE_SERVE_AUTOSCALE", None)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=120, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "CLEAN" in out.stdout
+
+    def test_env_autostart_and_bad_spec_loud(self):
+        code = """
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.monitor import alerts
+assert alerts.armed(), "PADDLE_ALERTS did not autostart"
+assert [r.name for r in alerts.rules()] == ["auto"]
+alerts.disarm()
+print("ARMED-OK")
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_ALERTS="a/b:threshold:gt=1:name=auto",
+                   PADDLE_ALERT_INTERVAL_S="60")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=120, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "ARMED-OK" in out.stdout
+
+        code_bad = """
+from paddle_tpu.core import monitor as cmon
+from paddle_tpu.monitor import alerts
+assert not alerts.armed()
+assert cmon.registry.snapshot()["alerts/spec_errors"] == 1
+print("LOUD-OK")
+"""
+        env["PADDLE_ALERTS"] = "totally:bogus:spec"
+        out = subprocess.run([sys.executable, "-c", code_bad],
+                             env=env, capture_output=True,
+                             text=True, timeout=120, cwd=REPO)
+        assert out.returncode == 0, out.stderr
+        assert "LOUD-OK" in out.stdout
+
+    def test_dump_bundle_carries_alerts_section(self, tmp_path):
+        alerts.configure(spec="db/x:threshold:gt=1:name=db",
+                         start=False)
+        path = flight.write_dump("alerts_test",
+                                 path=str(tmp_path / "dump.json"))
+        with open(path) as f:
+            bundle = json.load(f)
+        assert bundle["alerts"]["armed"] is True
+        assert bundle["alerts"]["rules"][0]["name"] == "db"
